@@ -1,0 +1,38 @@
+"""madsim_tpu — a TPU-native deterministic simulation-testing framework.
+
+Built from scratch with the capabilities of madsys-dev/madsim (a seeded
+deterministic simulator for distributed systems), re-architected for TPU:
+instead of one seed per single-threaded async runtime, the simulator core is
+a pure jitted `step(state) -> state` transition vmapped over a `[seed_batch]`
+axis, so thousands of trajectories (seeds) advance in lockstep as one XLA
+program and shard across chips with jax.sharding.
+
+    from madsim_tpu import Runtime, Program, Scenario, SimConfig, ms, sec
+"""
+
+from .core.api import Ctx, Program
+from .core.state import SimState
+from .core.types import (
+    CRASH_DEADLOCK,
+    CRASH_INVARIANT,
+    CRASH_TIME_LIMIT,
+    EV_MSG,
+    EV_SUPER,
+    EV_TIMER,
+    NODE_RANDOM,
+    NetConfig,
+    SimConfig,
+    ms,
+    sec,
+)
+from .harness.simtest import simtest
+from .runtime.runtime import Runtime
+from .runtime.scenario import Scenario
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Ctx", "Program", "SimState", "SimConfig", "NetConfig", "Runtime",
+    "Scenario", "simtest", "ms", "sec", "NODE_RANDOM", "EV_MSG", "EV_TIMER",
+    "EV_SUPER", "CRASH_DEADLOCK", "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
+]

@@ -1,0 +1,136 @@
+"""Handler-side API: what protocol code can do inside a transition.
+
+madsim application tasks call `Endpoint::send_to` (net/mod.rs:232),
+`time::sleep` (time/sleep.rs), and `rand::thread_rng` (rand.rs:118) as async
+ops against ambient thread-local context (runtime/context.rs). Here protocol
+code is a *state-machine handler* — `on_message` / `on_timer` / `init` — that
+receives a `Ctx` and records its effects (sends, timers, state update, crash
+or halt requests) functionally. The number of `send`/`set_timer` calls in a
+handler is static (it is traced Python); conditional behavior is expressed
+with the `when=` mask, keeping everything fixed-shape for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import prng
+from . import types as T
+
+
+def as_payload(payload, n_words: int) -> jax.Array:
+    """Coerce None / list of ints / array into an int32[n_words] payload.
+
+    madsim messages are `Box<dyn Any>` (net/mod.rs:366) — arbitrary heap
+    payloads. Fixed shapes require a typed encoding: protocols pack their
+    message fields into int32 words (see utils/structs.py for helpers).
+    """
+    if payload is None:
+        return jnp.zeros((n_words,), jnp.int32)
+    if isinstance(payload, (list, tuple)):
+        items = [jnp.asarray(x, jnp.int32) for x in payload]
+        assert len(items) <= n_words, "payload too wide for cfg.payload_words"
+        vec = jnp.stack(items) if items else jnp.zeros((0,), jnp.int32)
+        return jnp.concatenate(
+            [vec, jnp.zeros((n_words - len(items),), jnp.int32)])
+    arr = jnp.asarray(payload, jnp.int32)
+    assert arr.shape == (n_words,), f"payload shape {arr.shape} != ({n_words},)"
+    return arr
+
+
+class Ctx:
+    """Effect-collecting handler context (one node, one event, one trajectory).
+
+    Attributes:
+      node:  int32 — this node's id (madsim NodeId analog)
+      now:   int32 — virtual time in ticks
+      state: user pytree — this node's protocol state; REASSIGN it
+             (``ctx.state = new_state``) to update.
+    """
+
+    def __init__(self, cfg: T.SimConfig, node, now, key, state):
+        self.cfg = cfg
+        self.node = node
+        self.now = now
+        self.state = state
+        self._key = key
+        self._sends: list[dict[str, Any]] = []
+        self._timers: list[dict[str, Any]] = []
+        self._crash = jnp.asarray(False)
+        self._crash_code = jnp.asarray(0, jnp.int32)
+        self._halt = jnp.asarray(False)
+
+    # -- randomness (thread_rng analog; draws are replay-stable per event) --
+    def rand_key(self) -> jax.Array:
+        self._key, k = prng.split(self._key)
+        return k
+
+    def randint(self, lo, hi) -> jax.Array:
+        """Uniform int32 in [lo, hi] inclusive."""
+        return prng.randint(self.rand_key(), lo, hi)
+
+    def uniform(self) -> jax.Array:
+        return prng.uniform(self.rand_key())
+
+    def bernoulli(self, p) -> jax.Array:
+        return prng.bernoulli(self.rand_key(), p)
+
+    # -- effects -----------------------------------------------------------
+    def send(self, dst, tag, payload=None, *, when=True) -> None:
+        """Queue a message (Endpoint::send_to analog, net/mod.rs:232-307).
+
+        Delivery is scheduled by the engine at now + Uniform[latency range],
+        subject to packet loss and the clog matrix (network.rs:222-229).
+        `when` masks the send (handlers have static call counts).
+        """
+        self._sends.append(dict(
+            m=jnp.asarray(when) & jnp.asarray(True),
+            dst=jnp.asarray(dst, jnp.int32),
+            tag=jnp.asarray(tag, jnp.int32),
+            payload=as_payload(payload, self.cfg.payload_words),
+        ))
+
+    def set_timer(self, delay, tag, payload=None, *, when=True) -> None:
+        """Schedule on_timer(tag, payload) at now + delay ticks
+        (time::sleep analog, time/sleep.rs)."""
+        self._timers.append(dict(
+            m=jnp.asarray(when) & jnp.asarray(True),
+            delay=jnp.maximum(jnp.asarray(delay, jnp.int32), 0),
+            tag=jnp.asarray(tag, jnp.int32),
+            payload=as_payload(payload, self.cfg.payload_words),
+        ))
+
+    def crash_if(self, cond, code: int) -> None:
+        """Assert: if cond, the trajectory crashes with user code > 0 —
+        the panic-in-task analog; the harness reports the seed."""
+        cond = jnp.asarray(cond)
+        first = cond & ~self._crash
+        self._crash_code = jnp.where(first, jnp.asarray(code, jnp.int32),
+                                     self._crash_code)
+        self._crash = self._crash | cond
+
+    def halt_if(self, cond=True) -> None:
+        """Request normal end of simulation for this trajectory."""
+        self._halt = self._halt | jnp.asarray(cond)
+
+
+class Program:
+    """A node program: the NodeBuilder::init + task-body analog
+    (runtime/mod.rs:259-318), restructured as an explicit state machine
+    (the TLA+/P-style modeling of distributed protocols).
+
+    Subclass and override. All methods must be JAX-traceable (jnp ops,
+    no data-dependent Python control flow).
+    """
+
+    def init(self, ctx: Ctx) -> None:
+        """Node boot / restart: set initial state, arm initial timers."""
+
+    def on_message(self, ctx: Ctx, src, tag, payload) -> None:
+        """A message addressed to this node arrived."""
+
+    def on_timer(self, ctx: Ctx, tag, payload) -> None:
+        """A timer armed with set_timer fired."""

@@ -1,0 +1,40 @@
+"""Counter-based per-trajectory PRNG.
+
+madsim routes every random decision through one seeded SmallRng behind a mutex
+(madsim/src/sim/rand.rs:48-96); replay-by-seed works because the draw order is
+deterministic under the deterministic scheduler. Here each trajectory carries a
+threefry key in its state; every step splits it in a *fixed static order*
+(scheduler pick, supervisor draw, handler draws, per-send network draws), so a
+seed reproduces an execution bit-exactly — including on a different batch size
+or device layout, because trajectories never share randomness.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def seed_key(seed) -> jax.Array:
+    """uint32[2] threefry key from an int64-ish seed (vmappable)."""
+    seed = jnp.asarray(seed, dtype=jnp.uint32)
+    return jax.random.PRNGKey(seed)
+
+
+def split(key, n: int = 2):
+    return jax.random.split(key, n)
+
+
+def randint(key, lo, hi) -> jax.Array:
+    """Uniform int32 in [lo, hi] inclusive. hi >= lo."""
+    lo = jnp.asarray(lo, jnp.int32)
+    hi = jnp.asarray(hi, jnp.int32)
+    return jax.random.randint(key, (), lo, hi + 1, dtype=jnp.int32)
+
+
+def uniform(key) -> jax.Array:
+    return jax.random.uniform(key, (), dtype=jnp.float32)
+
+
+def bernoulli(key, p) -> jax.Array:
+    return jax.random.uniform(key, (), dtype=jnp.float32) < p

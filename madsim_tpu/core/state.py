@@ -1,0 +1,107 @@
+"""The simulation state pytree.
+
+One SimState value is an ENTIRE simulated cluster for one trajectory (seed):
+virtual clock, PRNG key, the event table (timers + in-flight messages +
+scheduled supervisor ops), per-node liveness and user protocol state, and the
+network fault matrix. The reference spreads this across GlobalRng
+(rand.rs:48), TimeRuntime (time/mod.rs), the executor's task queue (task.rs),
+Network {clogged_node, clogged_link, config, stat} (network.rs:20-29), and
+per-node mailboxes (net/mod.rs:368-411); here it is one fixed-shape pytree so
+that `vmap` batches thousands of clusters and `jit` compiles one XLA program
+that advances them all in lockstep.
+
+There are no mailboxes: madsim needs them because a receiver task may not yet
+be awaiting a tag when a message lands (net/mod.rs:368-411). In the
+state-machine model, delivery *is* the invocation of `on_message`, so the
+event table subsumes the mailbox.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from . import types as T
+
+
+@struct.dataclass
+class SimState:
+    # --- clock & rng & lifecycle -----------------------------------------
+    now: jax.Array          # int32 ticks — virtual clock (ClockHandle analog)
+    key: jax.Array          # uint32[2] — trajectory PRNG (GlobalRng analog)
+    halted: jax.Array       # bool — simulation finished (normally or crashed)
+    crashed: jax.Array      # bool — an invariant/assertion failed
+    crash_code: jax.Array   # int32 — which invariant (user >0, engine <0)
+    crash_node: jax.Array   # int32 — node implicated, -1 if n/a
+    oops: jax.Array         # int32 bitmask — capacity overflows
+    steps: jax.Array        # int32 — events dispatched so far
+
+    # --- event table [C] --------------------------------------------------
+    t_deadline: jax.Array   # int32[C] — fire time (T_INF when slot free)
+    t_kind: jax.Array       # int32[C] — EV_FREE/MSG/TIMER/SUPER
+    t_node: jax.Array       # int32[C] — destination node
+    t_src: jax.Array        # int32[C] — source node (msgs) / link src (super)
+    t_tag: jax.Array        # int32[C] — msg tag / timer tag / super opcode
+    t_payload: jax.Array    # int32[C, P]
+
+    # --- nodes ------------------------------------------------------------
+    alive: jax.Array        # bool[N]
+    paused: jax.Array       # bool[N]
+    node_state: Any         # user pytree, leaves with leading [N] axis
+
+    # --- network fault matrix (NetSim analog) ----------------------------
+    clog_node: jax.Array    # bool[N] — NetSim::clog_node
+    clog_link: jax.Array    # bool[N, N] — NetSim::clog_link (src, dst)
+    loss: jax.Array         # float32 — packet_loss_rate
+    lat_lo: jax.Array       # int32 ticks — send_latency range
+    lat_hi: jax.Array       # int32 ticks
+
+    # --- stats (NetSim::stat analog, network.rs:82-85) --------------------
+    msg_sent: jax.Array
+    msg_delivered: jax.Array
+    msg_dropped: jax.Array
+
+
+def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any) -> SimState:
+    """Fresh state for one trajectory. `node_state` must already carry the
+    leading [N] axis (Runtime stacks the per-node spec)."""
+    C, P, N = cfg.event_capacity, cfg.payload_words, cfg.n_nodes
+    i32 = jnp.int32
+    return SimState(
+        now=jnp.asarray(0, i32),
+        key=key,
+        halted=jnp.asarray(False),
+        crashed=jnp.asarray(False),
+        crash_code=jnp.asarray(T.CRASH_NONE, i32),
+        crash_node=jnp.asarray(-1, i32),
+        oops=jnp.asarray(0, i32),
+        steps=jnp.asarray(0, i32),
+        t_deadline=jnp.full((C,), T.T_INF, i32),
+        t_kind=jnp.zeros((C,), i32),
+        t_node=jnp.zeros((C,), i32),
+        t_src=jnp.zeros((C,), i32),
+        t_tag=jnp.zeros((C,), i32),
+        t_payload=jnp.zeros((C, P), i32),
+        alive=jnp.zeros((N,), bool),
+        paused=jnp.zeros((N,), bool),
+        node_state=node_state,
+        clog_node=jnp.zeros((N,), bool),
+        clog_link=jnp.zeros((N, N), bool),
+        loss=jnp.asarray(cfg.net.packet_loss_rate, jnp.float32),
+        lat_lo=jnp.asarray(cfg.net.send_latency_min, i32),
+        lat_hi=jnp.asarray(cfg.net.send_latency_max, i32),
+        msg_sent=jnp.asarray(0, i32),
+        msg_delivered=jnp.asarray(0, i32),
+        msg_dropped=jnp.asarray(0, i32),
+    )
+
+
+def tree_select(pred, on_true, on_false):
+    """Pytree select on a scalar predicate — freezes halted trajectories.
+
+    Inside the (per-trajectory) step `pred` is a scalar; vmap batches it.
+    """
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)

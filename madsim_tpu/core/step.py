@@ -1,0 +1,355 @@
+"""The event engine: one jitted, vmappable `step(state) -> (state, record)`.
+
+This is the TPU-native replacement for madsim's hot loop
+(Executor::block_on, task.rs:110-124):
+
+    reference (one seed, one thread)          this engine (B seeds, lockstep)
+    ---------------------------------         --------------------------------
+    pop random ready task (mpsc.rs:75)        masked categorical over earliest-
+                                              deadline ties (ops/select.py)
+    poll future, may send/sleep               dispatch handler; effects are
+                                              fixed-shape emission records
+    TimeRuntime::advance (time/mod.rs:41)     now = max(now, earliest deadline)
+    message = timer cb (net/mod.rs:301)       message = event-table row
+    Handle::kill/clog (runtime/mod.rs:214)    supervisor op = event-table row
+
+Every branch executes for every trajectory each step (vmap turns `cond` into
+`select`); masks decide what commits. That is the SIMD price of advancing
+thousands of seeds in lockstep, and it is why handlers must be small tensor
+programs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import select as sel
+from . import prng
+from . import types as T
+from .api import Ctx, Program
+from .state import SimState, tree_select
+
+
+def _where_tree(mask, new, old):
+    return jax.tree.map(lambda a, b: jnp.where(mask, a, b), new, old)
+
+
+def _slice_node(tree, node):
+    return jax.tree.map(lambda a: a[node], tree)
+
+
+def _scatter_node(tree, node, new, mask):
+    return jax.tree.map(
+        lambda full, val: full.at[node].set(jnp.where(mask, val, full[node])),
+        tree, new)
+
+
+EMPTY_SEND = lambda P: dict(
+    m=jnp.asarray(False), dst=jnp.asarray(0, jnp.int32),
+    tag=jnp.asarray(0, jnp.int32), payload=jnp.zeros((P,), jnp.int32))
+EMPTY_TIMER = lambda P: dict(
+    m=jnp.asarray(False), delay=jnp.asarray(0, jnp.int32),
+    tag=jnp.asarray(0, jnp.int32), payload=jnp.zeros((P,), jnp.int32))
+
+
+def make_step(
+    cfg: T.SimConfig,
+    programs: Sequence[Program],
+    node_prog: np.ndarray,
+    state_spec: Any,
+    invariant: Callable[[SimState], tuple[jax.Array, jax.Array]] | None = None,
+) -> Callable[[SimState], tuple[SimState, dict[str, jax.Array]]]:
+    """Build the per-trajectory step function.
+
+    Args:
+      cfg: static SimConfig.
+      programs: node programs; node i runs programs[node_prog[i]].
+      node_prog: int array [N] mapping node -> program index (static).
+      state_spec: one node's default user-state pytree (no N axis).
+      invariant: optional global safety check `f(state) -> (bad, code)`
+        evaluated after every dispatch (e.g. Raft election safety). This is
+        strictly stronger than the reference, where the supervisor can only
+        observe at its own wakeups.
+    """
+    node_prog = np.asarray(node_prog, np.int32)
+    assert node_prog.shape == (cfg.n_nodes,)
+    assert node_prog.min() >= 0 and node_prog.max() < len(programs)
+    node_prog_j = jnp.asarray(node_prog)
+    P = cfg.payload_words
+    spec_default = jax.tree.map(lambda a: jnp.asarray(a), state_spec)
+
+    def live_step(s: SimState):
+        key, k_sched, k_super, k_handler, k_net = prng.split(s.key, 5)
+
+        # ---- 1. pick next event: earliest eligible deadline, random tie-break
+        occupied = s.t_kind != T.EV_FREE
+        tnode = jnp.clip(s.t_node, 0, cfg.n_nodes - 1)
+        parked = (s.alive[tnode] & s.paused[tnode]
+                  & (s.t_kind != T.EV_SUPER))  # paused nodes park their events
+        eligible = occupied & ~parked
+        dmin, at_min, any_ev = sel.min_deadline(s.t_deadline, eligible, T.T_INF)
+        idx, picked = sel.masked_choice(k_sched, at_min)
+        valid = picked & any_ev
+
+        ev_kind = jnp.where(valid, s.t_kind[idx], T.EV_FREE)
+        ev_node = jnp.clip(s.t_node[idx], 0, cfg.n_nodes - 1)
+        ev_src = s.t_src[idx]
+        ev_tag = s.t_tag[idx]
+        ev_payload = s.t_payload[idx]
+
+        # pop the slot; clock never runs backward (resumed nodes' past-due
+        # events fire "now", the park/unpark analog of task.rs:134-137)
+        now = jnp.where(valid, jnp.maximum(s.now, dmin), s.now)
+        # strict >: the scenario's HALT op sits at exactly time_limit, and
+        # same-deadline ties may dispatch before it without being late
+        time_over = now > jnp.asarray(cfg.time_limit, jnp.int32)
+        s = s.replace(
+            key=key,
+            now=now,
+            t_kind=s.t_kind.at[idx].set(
+                jnp.where(valid, T.EV_FREE, s.t_kind[idx])),
+            t_deadline=s.t_deadline.at[idx].set(
+                jnp.where(valid, T.T_INF, s.t_deadline[idx])),
+        )
+
+        # ---- 2. supervisor op (Handle::kill/restart/... as events) ---------
+        is_super = valid & (ev_kind == T.EV_SUPER)
+        op = jnp.where(is_super, ev_tag, 0)
+        s, init_node = _apply_super(cfg, spec_default, s, op, ev_node, ev_src,
+                                    ev_payload, k_super)
+
+        # ---- 3. protocol handler dispatch ---------------------------------
+        node_ok = s.alive[ev_node] & ~s.paused[ev_node]
+        is_msg = valid & (ev_kind == T.EV_MSG) & node_ok
+        is_timer = valid & (ev_kind == T.EV_TIMER) & node_ok
+        is_init = init_node >= 0
+        dropped = valid & (ev_kind == T.EV_MSG) & ~node_ok
+        h_node = jnp.where(is_init, jnp.clip(init_node, 0, cfg.n_nodes - 1),
+                           ev_node)
+        base_slice = _slice_node(s.node_state, h_node)
+
+        combos = []  # (mask, ctx) pairs; masks are mutually exclusive
+        for p_idx, prog in enumerate(programs):
+            pmask = node_prog_j[h_node] == p_idx
+            for hkind, run in (
+                (is_init, lambda c: prog.init(c)),
+                (is_msg, lambda c: prog.on_message(c, ev_src, ev_tag,
+                                                   ev_payload)),
+                (is_timer, lambda c: prog.on_timer(c, ev_tag, ev_payload)),
+            ):
+                ctx = Ctx(cfg, h_node, s.now, k_handler, base_slice)
+                run(ctx)
+                combos.append((hkind & pmask, ctx))
+
+        # merge combo results (masks are mutually exclusive by construction)
+        any_h = functools.reduce(jnp.logical_or, [m for m, _ in combos])
+        new_slice = base_slice
+        crash = jnp.asarray(False)
+        crash_code = jnp.asarray(0, jnp.int32)
+        halt_req = jnp.asarray(False)
+        n_sends = max((len(c._sends) for _, c in combos), default=0)
+        n_timers = max((len(c._timers) for _, c in combos), default=0)
+        sends = [EMPTY_SEND(P) for _ in range(n_sends)]
+        timers = [EMPTY_TIMER(P) for _ in range(n_timers)]
+        for m, ctx in combos:
+            new_slice = _where_tree(m, ctx.state, new_slice)
+            crash = crash | (m & ctx._crash)
+            crash_code = jnp.where(m & ctx._crash, ctx._crash_code, crash_code)
+            halt_req = halt_req | (m & ctx._halt)
+            for j, e in enumerate(ctx._sends):
+                e = dict(e, m=e["m"] & m)
+                sends[j] = _where_tree(m, e, sends[j])
+            for j, e in enumerate(ctx._timers):
+                e = dict(e, m=e["m"] & m)
+                timers[j] = _where_tree(m, e, timers[j])
+
+        s = s.replace(
+            node_state=_scatter_node(s.node_state, h_node, new_slice, any_h))
+
+        # ---- 4. materialize emissions into the event table ----------------
+        free = s.t_kind == T.EV_FREE
+        slots, slot_ok = sel.first_k_free(free, n_sends + n_timers)
+        overflow = jnp.asarray(False)
+        t_deadline, t_kind = s.t_deadline, s.t_kind
+        t_node, t_src, t_tag, t_payload = s.t_node, s.t_src, s.t_tag, s.t_payload
+        net_keys = prng.split(k_net, 2 * max(n_sends, 1))
+        sent = delivered_drop = jnp.asarray(0, jnp.int32)
+
+        for j, e in enumerate(sends):
+            dst = jnp.clip(e["dst"], 0, cfg.n_nodes - 1)
+            # network fault model: clog + loss + latency (network.rs:222-229)
+            clogged = (s.clog_node[h_node] | s.clog_node[dst]
+                       | s.clog_link[h_node, dst])
+            lost = prng.bernoulli(net_keys[2 * j], s.loss)
+            latency = prng.randint(net_keys[2 * j + 1], s.lat_lo, s.lat_hi)
+            ok = e["m"] & ~clogged & ~lost
+            sent = sent + e["m"].astype(jnp.int32)
+            delivered_drop = delivered_drop + (e["m"] & ~ok).astype(jnp.int32)
+            slot, sok = slots[j], slot_ok[j]
+            write = ok & sok
+            overflow = overflow | (ok & ~sok)
+            t_deadline = t_deadline.at[slot].set(
+                jnp.where(write, s.now + latency, t_deadline[slot]))
+            t_kind = t_kind.at[slot].set(
+                jnp.where(write, T.EV_MSG, t_kind[slot]))
+            t_node = t_node.at[slot].set(jnp.where(write, dst, t_node[slot]))
+            t_src = t_src.at[slot].set(jnp.where(write, h_node, t_src[slot]))
+            t_tag = t_tag.at[slot].set(jnp.where(write, e["tag"], t_tag[slot]))
+            t_payload = t_payload.at[slot].set(
+                jnp.where(write, e["payload"], t_payload[slot]))
+
+        for j, e in enumerate(timers):
+            slot, sok = slots[n_sends + j], slot_ok[n_sends + j]
+            write = e["m"] & sok
+            overflow = overflow | (e["m"] & ~sok)
+            t_deadline = t_deadline.at[slot].set(
+                jnp.where(write, s.now + e["delay"], t_deadline[slot]))
+            t_kind = t_kind.at[slot].set(
+                jnp.where(write, T.EV_TIMER, t_kind[slot]))
+            t_node = t_node.at[slot].set(jnp.where(write, h_node, t_node[slot]))
+            t_src = t_src.at[slot].set(jnp.where(write, h_node, t_src[slot]))
+            t_tag = t_tag.at[slot].set(jnp.where(write, e["tag"], t_tag[slot]))
+            t_payload = t_payload.at[slot].set(
+                jnp.where(write, e["payload"], t_payload[slot]))
+
+        s = s.replace(
+            t_deadline=t_deadline, t_kind=t_kind, t_node=t_node, t_src=t_src,
+            t_tag=t_tag, t_payload=t_payload,
+            msg_sent=s.msg_sent + sent,
+            msg_delivered=s.msg_delivered + is_msg.astype(jnp.int32),
+            msg_dropped=s.msg_dropped + delivered_drop
+            + dropped.astype(jnp.int32),
+            oops=s.oops | jnp.where(overflow, T.OOPS_EVENT_OVERFLOW, 0)
+            | jnp.where(s.now > T.T_INF - 64 * T.TICKS_PER_SEC,
+                        T.OOPS_TIME_OVERFLOW, 0),
+            steps=s.steps + 1,
+        )
+
+        # ---- 5. end conditions -------------------------------------------
+        # deadlock: nothing can ever run again (madsim task.rs:116 panic)
+        crash = crash | ~any_ev | time_over
+        crash_code = jnp.where(
+            ~any_ev, T.CRASH_DEADLOCK,
+            jnp.where(time_over & (crash_code == 0), T.CRASH_TIME_LIMIT,
+                      crash_code))
+        halted_now = halt_req | (is_super & (op == T.OP_HALT))
+
+        if invariant is not None:
+            bad, code = invariant(s)
+            first = bad & ~crash
+            crash_code = jnp.where(first, code, crash_code)
+            crash = crash | bad
+
+        s = s.replace(
+            crashed=s.crashed | crash,
+            crash_code=jnp.where(crash & (s.crash_code == 0), crash_code,
+                                 s.crash_code),
+            crash_node=jnp.where(crash & (s.crash_node < 0), h_node,
+                                 s.crash_node),
+            halted=s.halted | halted_now | crash,
+        )
+
+        record = dict(
+            now=s.now, kind=ev_kind.astype(jnp.int32), node=ev_node,
+            src=ev_src, tag=ev_tag, payload=ev_payload,
+            fired=valid,
+        )
+        return s, record
+
+    def step(s: SimState):
+        ns, record = live_step(s)
+        out = tree_select(s.halted, s, ns)
+        record = dict(record, fired=record["fired"] & ~s.halted)
+        return out, record
+
+    return step
+
+
+def _apply_super(cfg, spec_default, s: SimState, op, node, src, payload, key):
+    """Apply one supervisor opcode as masked state edits.
+
+    Returns (state, init_node) where init_node >= 0 requests the program
+    `init` handler to run on that node this step (OP_INIT / OP_RESTART —
+    the NodeBuilder::init respawn of runtime/mod.rs:287-295).
+    """
+    k_t, _ = prng.split(key)
+    N = cfg.n_nodes
+
+    # resolve NODE_RANDOM targets (fuzzing): each op draws from the pool of
+    # nodes it can meaningfully act on — kill/pause/clog a random alive node,
+    # restart a random dead one, resume a random paused one, unclog a random
+    # clogged one
+    want_alive = (op == T.OP_KILL) | (op == T.OP_PAUSE) | (op == T.OP_CLOG_NODE)
+    pool = jnp.where(want_alive, s.alive,
+                     jnp.where(op == T.OP_RESTART, ~s.alive,
+                               jnp.where(op == T.OP_RESUME, s.paused,
+                                         jnp.where(op == T.OP_UNCLOG_NODE,
+                                                   s.clog_node,
+                                                   jnp.ones((N,), bool)))))
+    rnd, rnd_ok = sel.masked_choice(k_t, pool)
+    is_random = node == T.NODE_RANDOM
+    target = jnp.clip(jnp.where(is_random, rnd, node), 0, N - 1)
+    effective = ~is_random | rnd_ok  # no eligible random target -> no-op
+    src_c = jnp.clip(src, 0, N - 1)
+
+    def when(cond):
+        return cond & effective
+
+    kill = when((op == T.OP_KILL) | (op == T.OP_RESTART))
+    boot = when((op == T.OP_INIT) | (op == T.OP_RESTART))
+
+    # KILL: drop the node's queued events — its tasks die (task.rs:170-182)
+    # and its sockets close so undelivered messages vanish (network.rs:113-118)
+    clear = kill & (s.t_node == target) & (
+        (s.t_kind == T.EV_MSG) | (s.t_kind == T.EV_TIMER))
+    t_kind = jnp.where(clear, T.EV_FREE, s.t_kind)
+    t_deadline = jnp.where(clear, T.T_INF, s.t_deadline)
+
+    alive = s.alive.at[target].set(
+        jnp.where(kill & ~boot, False,
+                  jnp.where(boot, True, s.alive[target])))
+    paused = s.paused.at[target].set(
+        jnp.where(kill | boot | when(op == T.OP_RESUME), False,
+                  jnp.where(when(op == T.OP_PAUSE), True, s.paused[target])))
+
+    # node boot/restart resets protocol state to the spec default — process
+    # memory does not survive a crash
+    node_state = _scatter_node(s.node_state, target, spec_default, boot)
+
+    clog_node = s.clog_node.at[target].set(
+        jnp.where(when(op == T.OP_CLOG_NODE), True,
+                  jnp.where(when(op == T.OP_UNCLOG_NODE), False,
+                            s.clog_node[target])))
+    clog_link = s.clog_link.at[src_c, target].set(
+        jnp.where(when(op == T.OP_CLOG_LINK), True,
+                  jnp.where(when(op == T.OP_UNCLOG_LINK), False,
+                            s.clog_link[src_c, target])))
+
+    # whole-matrix ops: OP_PARTITION replaces the link matrix with the cut
+    # A <-> not-A (payload packs membership 31 nodes/word); OP_HEAL clears
+    # everything
+    node_ids = jnp.arange(N, dtype=jnp.int32)
+    in_a = ((payload[node_ids // 31] >> (node_ids % 31)) & 1).astype(bool)
+    cut = in_a[:, None] != in_a[None, :]
+    clog_link = jnp.where(when(op == T.OP_PARTITION), cut, clog_link)
+    clog_link = jnp.where(when(op == T.OP_HEAL),
+                          jnp.zeros_like(clog_link), clog_link)
+    clog_node = jnp.where(when(op == T.OP_HEAL),
+                          jnp.zeros_like(clog_node), clog_node)
+
+    loss = jnp.where(when(op == T.OP_SET_LOSS),
+                     payload[0].astype(jnp.float32) / 1e6, s.loss)
+    lat_lo = jnp.where(when(op == T.OP_SET_LATENCY), payload[0], s.lat_lo)
+    lat_hi = jnp.where(when(op == T.OP_SET_LATENCY),
+                       jnp.maximum(payload[1], payload[0]), s.lat_hi)
+
+    init_node = jnp.where(boot, target, jnp.asarray(-1, jnp.int32))
+    s = s.replace(t_kind=t_kind, t_deadline=t_deadline, alive=alive,
+                  paused=paused, node_state=node_state, clog_node=clog_node,
+                  clog_link=clog_link, loss=loss, lat_lo=lat_lo, lat_hi=lat_hi)
+    return s, init_node

@@ -1,0 +1,136 @@
+"""Core constants, event kinds, supervisor opcodes, and static simulation config.
+
+TPU-native rethink of madsim's world: instead of an async executor with a
+random-pop ready queue (reference: madsim/src/sim/task.rs:88-143) plus a
+binary-heap timer wheel (madsim/src/sim/time/mod.rs:41-56), the whole
+simulation is ONE fixed-shape event table. Every future occurrence — a message
+delivery (madsim/src/sim/net/mod.rs:301-306 schedules messages as timers), a
+protocol timer, a supervisor fault-injection op — is a row in the timer table.
+The step function pops the earliest eligible row (random tie-break, mirroring
+the seeded random ready-queue pop of madsim/src/sim/utils/mpsc.rs:75-85) and
+dispatches it. All shapes are static so the step jit-compiles and vmaps over a
+[seed_batch] leading axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Time. Virtual time is int32 *ticks*; 1 tick == 1 microsecond. This bounds a
+# trajectory at ~35 simulated minutes (2**31 us), far beyond any chaos test in
+# the reference suite (which run simulated seconds). An overflow sets an oops
+# bit instead of wrapping.
+# ---------------------------------------------------------------------------
+TICKS_PER_MS = 1_000
+TICKS_PER_SEC = 1_000_000
+T_INF = np.int32(2**31 - 1)
+
+# ---------------------------------------------------------------------------
+# Event kinds (t_kind column of the event table).
+# ---------------------------------------------------------------------------
+EV_FREE = 0    # empty slot
+EV_MSG = 1     # message delivery (madsim: net/mod.rs:301-306 timer-scheduled)
+EV_TIMER = 2   # protocol timer (madsim: time/sleep.rs)
+EV_SUPER = 3   # supervisor op (madsim: Handle::kill/... runtime/mod.rs:214-245)
+
+# ---------------------------------------------------------------------------
+# Supervisor opcodes (t_tag column when t_kind == EV_SUPER).
+# Mirrors the fault-injection surface of madsim::runtime::Handle
+# (runtime/mod.rs:200-256) and NetSim (net/mod.rs:98-157).
+# ---------------------------------------------------------------------------
+OP_INIT = 1          # run program.init on node (node boot; NodeBuilder::init)
+OP_KILL = 2          # Handle::kill — drop tasks, reset sim node state
+OP_RESTART = 3       # Handle::restart — kill + re-run init closure
+OP_PAUSE = 4         # Handle::pause
+OP_RESUME = 5        # Handle::resume
+OP_CLOG_NODE = 6     # NetSim::clog_node (disconnect)
+OP_UNCLOG_NODE = 7   # NetSim::unclog_node (connect)
+OP_CLOG_LINK = 8     # NetSim::clog_link (disconnect2); args (src=t_src, dst=t_node)
+OP_UNCLOG_LINK = 9   # NetSim::unclog_link (connect2)
+OP_SET_LOSS = 10     # update packet_loss_rate; payload[0] = rate * 1e6
+OP_HALT = 11         # end of simulation (time limit)
+OP_SET_LATENCY = 12  # payload[0]=lo ticks, payload[1]=hi ticks
+OP_HEAL = 13         # clear the whole clog matrix + clogged nodes
+OP_PARTITION = 14    # payload[0] = bitmask of group A; cuts A <-> not-A both
+                     # ways (single-row analog of N^2 disconnect2 calls)
+
+# Node argument sentinel: draw a random target at fire time (fuzzing aid).
+# KILL/PAUSE/CLOG pick a random *alive* node; RESTART picks a random *dead* one.
+NODE_RANDOM = -1
+
+# ---------------------------------------------------------------------------
+# Crash codes (state.crash_code). User codes must be > 0.
+# ---------------------------------------------------------------------------
+CRASH_NONE = 0
+CRASH_DEADLOCK = -1        # no eligible event and no HALT reached
+                           # (madsim panics "the task will block forever",
+                           #  task.rs:110-124)
+CRASH_TIME_LIMIT = -2      # virtual-time limit exceeded (set_time_limit)
+CRASH_INVARIANT = -3       # global invariant check failed (generic)
+
+# Oops bits (state.oops) — resource-exhaustion flags instead of UB. The
+# reference grows Vecs unboundedly; static shapes require capacities.
+OOPS_EVENT_OVERFLOW = 1    # event table full; an emission was dropped
+OOPS_TIME_OVERFLOW = 2     # virtual clock would exceed int32 ticks
+
+
+@dataclasses.dataclass(frozen=True)
+class NetConfig:
+    """Network fault model — madsim sim::net::config::Config
+    (network.rs:49-69): packet loss rate + latency range.
+
+    Latencies are ticks (us). Reference default: 1-10 ms latency, 0 loss.
+    """
+
+    packet_loss_rate: float = 0.0
+    send_latency_min: int = 1 * TICKS_PER_MS
+    send_latency_max: int = 10 * TICKS_PER_MS
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static (compile-time) simulation configuration.
+
+    Everything here shapes the XLA program: changing any field recompiles.
+    Dynamic knobs (current loss rate, latency range, clog matrix) live in
+    SimState and can change mid-run via supervisor ops.
+    """
+
+    n_nodes: int
+    event_capacity: int = 128      # rows in the event table, per trajectory
+    payload_words: int = 8         # int32 words per message/timer payload
+    time_limit: int = 10 * TICKS_PER_SEC
+    net: NetConfig = dataclasses.field(default_factory=NetConfig)
+    collect_stats: bool = True
+
+    def __post_init__(self):
+        assert self.n_nodes >= 1
+        assert self.event_capacity >= 4
+        assert self.payload_words >= 1
+
+    def hash(self) -> str:
+        """Stable 8-hex-digit config hash, printed on test failure so a repro
+        requires the same config — madsim sim::config::Config::hash
+        (config.rs:27-31) and the MADSIM_CONFIG_HASH echo (macros lib.rs:189).
+        """
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:8]
+
+
+def ms(x: float) -> int:
+    """Milliseconds -> ticks."""
+    return int(x * TICKS_PER_MS)
+
+
+def sec(x: float) -> int:
+    """Seconds -> ticks."""
+    return int(x * TICKS_PER_SEC)
+
+
+PyTree = Any

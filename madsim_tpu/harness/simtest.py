@@ -1,0 +1,103 @@
+"""Chaos-test harness: the `#[madsim::test]` analog.
+
+The reference macro expands every test into a seed loop driven by env vars —
+MADSIM_TEST_SEED, MADSIM_TEST_NUM, MADSIM_TEST_TIME_LIMIT,
+MADSIM_TEST_CHECK_DETERMINISM — and prints a `MADSIM_TEST_SEED={seed}` repro
+line plus a config hash on failure (madsim-macros/src/lib.rs:120-206). Here
+the seed loop IS the batch axis: MADSIM_TEST_NUM seeds run as one vmapped
+program, and the repro line points at the first crashed trajectory, which can
+then be replayed alone with `Runtime.run_single` for a full event trace.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import os
+from typing import Callable
+
+import numpy as np
+
+from ..core import types as T
+from ..runtime.runtime import Runtime
+
+_CODE_NAMES = {
+    T.CRASH_DEADLOCK: "DEADLOCK (no runnable event — 'task will block forever')",
+    T.CRASH_TIME_LIMIT: "TIME_LIMIT exceeded",
+    T.CRASH_INVARIANT: "INVARIANT violated",
+}
+
+
+class SimFailure(AssertionError):
+    def __init__(self, seed, code, node, cfg_hash, msg=""):
+        self.seed, self.code, self.node = int(seed), int(code), int(node)
+        name = _CODE_NAMES.get(self.code, f"user crash code {self.code}")
+        super().__init__(
+            f"simulation failed: {name} at node {self.node}. {msg}\n"
+            f"reproduce with: MADSIM_TEST_SEED={self.seed} "
+            f"(MADSIM_CONFIG_HASH={cfg_hash})")
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def run_seeds(rt: Runtime, seeds, max_steps: int, chunk: int = 512):
+    """Run a seed batch to completion; raise SimFailure on the first crashed
+    seed (lowest index). Returns the final batched state."""
+    state, _ = rt.run(rt.init_batch(np.asarray(seeds, np.uint32)), max_steps,
+                      chunk=chunk)
+    crashed = np.asarray(state.crashed)
+    if crashed.any():
+        i = int(np.argmax(crashed))
+        raise SimFailure(
+            seeds[i], np.asarray(state.crash_code)[i],
+            np.asarray(state.crash_node)[i], rt.cfg.hash(),
+            msg=f"({int(crashed.sum())}/{len(seeds)} seeds crashed)")
+    oops = np.asarray(state.oops)
+    if (oops != 0).any():
+        i = int(np.argmax(oops != 0))
+        raise SimFailure(
+            seeds[i], 0, -1, rt.cfg.hash(),
+            msg=f"capacity overflow (oops bits {int(oops[i])}) — raise "
+                f"event_capacity")
+    return state
+
+
+def simtest(num_seeds: int = 16, max_steps: int = 20_000,
+            seed: int | None = None, check_determinism: bool = False,
+            chunk: int = 512):
+    """Decorator: the wrapped function builds and returns a Runtime (or
+    (Runtime, check_fn) where check_fn(final_state) does extra asserts).
+
+    Env knobs (same contract as the reference macro):
+      MADSIM_TEST_SEED               base seed (default: stable per-test hash)
+      MADSIM_TEST_NUM                number of seeds (the batch axis!)
+      MADSIM_TEST_CHECK_DETERMINISM  also run seed twice and compare state
+    """
+
+    def deco(fn: Callable):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if seed is not None:
+                default_seed = seed
+            else:  # stable across interpreter runs (hash() is randomized)
+                digest = hashlib.sha256(fn.__qualname__.encode()).hexdigest()
+                default_seed = int(digest[:8], 16) % (2**31)
+            base = _env_int("MADSIM_TEST_SEED", default_seed)
+            n = _env_int("MADSIM_TEST_NUM", num_seeds)
+            out = fn(*args, **kwargs)
+            rt, check_fn = out if isinstance(out, tuple) else (out, None)
+            seeds = np.arange(base, base + n, dtype=np.uint32)
+            state = run_seeds(rt, seeds, max_steps, chunk)
+            if check_fn is not None:
+                check_fn(state)
+            if check_determinism or os.environ.get(
+                    "MADSIM_TEST_CHECK_DETERMINISM"):
+                assert rt.check_determinism(base, max_steps), (
+                    f"nondeterminism detected for seed {base} "
+                    f"(MADSIM_CONFIG_HASH={rt.cfg.hash()})")
+            return state
+        return wrapper
+    return deco

@@ -1,0 +1,54 @@
+"""Vectorized selection primitives for the event engine.
+
+These are the TPU-native equivalents of madsim's two scheduler data
+structures: the random-pop ready queue (madsim/src/sim/utils/mpsc.rs:75-85 —
+`try_recv_random` picks a uniformly random element with the global RNG) and
+the binary-heap timer (madsim/src/sim/time/mod.rs:41-56 — pop earliest
+deadline). Both become masked reductions over the fixed-shape event table:
+argmin for the next deadline, a masked categorical draw for the tie-break.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_choice(key, mask):
+    """Pick a uniformly random index among True entries of `mask`.
+
+    Returns (idx:int32, valid:bool). idx is 0 when no entry is set (callers
+    must gate on `valid`). Deterministic given `key` — this is the replayable
+    analog of mpsc.rs:75 `try_recv_random`.
+    """
+    mask = mask.astype(jnp.int32)
+    cnt = mask.sum()
+    r = jax.random.randint(key, (), 0, jnp.maximum(cnt, 1), dtype=jnp.int32)
+    cum = jnp.cumsum(mask)
+    idx = jnp.argmax(cum == r + 1).astype(jnp.int32)
+    return idx, cnt > 0
+
+
+def min_deadline(deadlines, eligible, inf):
+    """Earliest eligible deadline and its tie mask.
+
+    Returns (dmin:int32, at_min:bool[T], any_eligible:bool).
+    """
+    masked = jnp.where(eligible, deadlines, inf)
+    dmin = masked.min()
+    any_eligible = dmin < inf
+    at_min = eligible & (deadlines == dmin)
+    return dmin, at_min, any_eligible
+
+
+def first_k_free(free_mask, k: int):
+    """Indices of the first k free slots (stable by index).
+
+    Returns (slots:int32[k], ok:bool[k]) where ok[j] is False when fewer than
+    j+1 slots are free. Uses a stable argsort so allocation order is
+    deterministic.
+    """
+    order = jnp.argsort(~free_mask, stable=True)
+    slots = order[:k].astype(jnp.int32)
+    ok = jnp.arange(k, dtype=jnp.int32) < free_mask.sum(dtype=jnp.int32)
+    return slots, ok

@@ -1,0 +1,187 @@
+"""Runtime: the batched supervisor — madsim::runtime::Runtime, vectorized.
+
+The reference Runtime owns RNG + executor + simulators and drives one seed to
+completion on one thread (runtime/mod.rs:39-187). This Runtime compiles the
+step engine once and drives a whole `[seed_batch]` of clusters through it in
+fixed-size scan chunks, syncing to the host only between chunks (to test
+"all halted" and to let host code inspect/fault-inject). Chunked scanning is
+the host/device boundary discipline: supervisor logic lives in the scenario
+table *inside* the trace; the Python loop only orchestrates jitted calls.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import prng
+from ..core import types as T
+from ..core.api import Program
+from ..core.state import SimState, init_state
+from ..core.step import make_step
+from ..utils.hashing import fingerprint
+from .scenario import Scenario
+
+
+class Runtime:
+    """Batched simulation runtime.
+
+    Args:
+      cfg: static SimConfig.
+      programs: node programs (state machines).
+      state_spec: one node's default protocol-state pytree (no node axis).
+      node_prog: node -> program index (default: all nodes run programs[0]).
+      scenario: scheduled supervisor ops; a HALT at cfg.time_limit is
+        appended automatically if the scenario has none (set_time_limit
+        analog, runtime/mod.rs:175-177).
+      invariant: optional global safety check f(state) -> (bad, code).
+    """
+
+    def __init__(self, cfg: T.SimConfig, programs: Sequence[Program],
+                 state_spec: Any, node_prog=None,
+                 scenario: Scenario | None = None,
+                 invariant: Callable | None = None):
+        self.cfg = cfg
+        self.programs = list(programs)
+        self.state_spec = state_spec
+        self.node_prog = np.asarray(
+            node_prog if node_prog is not None
+            else np.zeros(cfg.n_nodes, np.int32), np.int32)
+        # copy the scenario so the auto-HALT never mutates a caller's object
+        # that might be shared across Runtimes with different time limits
+        self.scenario = Scenario()
+        if scenario is not None:
+            self.scenario.rows = list(scenario.rows)
+        if not self.scenario.has_halt():
+            self.scenario.at(cfg.time_limit).halt()
+        self.invariant = invariant
+        self._step = make_step(cfg, self.programs, self.node_prog,
+                               self.state_spec, invariant)
+        self._template = self._build_template()
+
+    # ------------------------------------------------------------------
+    def _build_template(self) -> SimState:
+        """One-trajectory initial state with the event table pre-loaded:
+        an OP_INIT row per node at t=0 (node boot) + all scenario rows."""
+        cfg = self.cfg
+        rows = self.scenario.build(cfg)
+        n_init = cfg.n_nodes
+        n_rows = n_init + rows["time"].shape[0]
+        if n_rows > cfg.event_capacity:
+            raise ValueError(
+                f"scenario ({n_rows} rows) exceeds event_capacity "
+                f"({cfg.event_capacity})")
+        node_state = jax.tree.map(
+            lambda a: jnp.broadcast_to(jnp.asarray(a),
+                                       (cfg.n_nodes,) + jnp.asarray(a).shape),
+            self.state_spec)
+        s = init_state(cfg, prng.seed_key(0), node_state)
+
+        C, Pw = cfg.event_capacity, cfg.payload_words
+        deadline = np.full(C, T.T_INF, np.int32)
+        kind = np.zeros(C, np.int32)
+        node = np.zeros(C, np.int32)
+        src = np.zeros(C, np.int32)
+        tag = np.zeros(C, np.int32)
+        payload = np.zeros((C, Pw), np.int32)
+        # node boots
+        deadline[:n_init] = 0
+        kind[:n_init] = T.EV_SUPER
+        node[:n_init] = np.arange(n_init)
+        tag[:n_init] = T.OP_INIT
+        # scenario ops
+        r = rows["time"].shape[0]
+        deadline[n_init:n_rows] = rows["time"]
+        kind[n_init:n_rows] = T.EV_SUPER
+        node[n_init:n_rows] = rows["node"]
+        src[n_init:n_rows] = rows["src"]
+        tag[n_init:n_rows] = rows["op"]
+        payload[n_init:n_rows] = rows["payload"]
+        return s.replace(
+            t_deadline=jnp.asarray(deadline), t_kind=jnp.asarray(kind),
+            t_node=jnp.asarray(node), t_src=jnp.asarray(src),
+            t_tag=jnp.asarray(tag), t_payload=jnp.asarray(payload))
+
+    # ------------------------------------------------------------------
+    def init_batch(self, seeds) -> SimState:
+        """Initial batched state for an array of seeds (replay-by-seed:
+        the same seed always reproduces the same trajectory, the
+        MADSIM_TEST_SEED contract of macros lib.rs:141-145)."""
+        seeds = jnp.atleast_1d(jnp.asarray(seeds, jnp.uint32))
+        keys = jax.vmap(prng.seed_key)(seeds)
+        batched = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (seeds.shape[0],) + a.shape),
+            self._template)
+        return batched.replace(key=keys)
+
+    def init_single(self, seed: int) -> SimState:
+        return self.init_batch(jnp.asarray([seed], jnp.uint32))
+
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def _run_chunk(self):
+        return {True: self._compile_chunk(True),
+                False: self._compile_chunk(False)}
+
+    def _compile_chunk(self, collect_events: bool):
+        # scan over steps of the vmapped step: one XLA program advances the
+        # whole batch chunk_len times
+        vstep = jax.vmap(self._step)
+
+        def run(state: SimState, chunk_len: int):
+            def body(s, _):
+                s, rec = vstep(s)
+                return s, (rec if collect_events else 0)
+            return jax.lax.scan(body, state, length=chunk_len)
+
+        return jax.jit(run, static_argnums=1, donate_argnums=0)
+
+    def run(self, state: SimState, max_steps: int, chunk: int = 512,
+            collect_events: bool = False):
+        """Advance until every trajectory halts or ~max_steps events each
+        (rounded up to a chunk multiple). Returns (state, events|None).
+        """
+        # always run full chunks: halted trajectories are frozen by
+        # tree_select, so overshooting max_steps is free and avoids a second
+        # XLA compile for a partial tail chunk
+        runner = self._run_chunk[collect_events]
+        events = [] if collect_events else None
+        done = 0
+        while done < max_steps:
+            state, recs = runner(state, chunk)
+            done += chunk
+            if collect_events:
+                events.append(jax.tree.map(np.asarray, recs))
+            if bool(state.halted.all()):
+                break
+        if collect_events and events:
+            events = jax.tree.map(
+                lambda *xs: np.concatenate(xs, axis=0), *events)
+        return state, events
+
+    def run_single(self, seed: int, max_steps: int, chunk: int = 512,
+                   collect_events: bool = True):
+        """Debug path: one seed, optionally with the event trace — the
+        single-seed replay used to debug a failing seed (the env_logger +
+        MADSIM_TEST_SEED repro analog)."""
+        state = self.init_single(seed)
+        return self.run(state, max_steps, chunk, collect_events)
+
+    # ------------------------------------------------------------------
+    def fingerprints(self, state: SimState) -> np.ndarray:
+        """uint32 fingerprint per trajectory (determinism checks)."""
+        return np.asarray(jax.jit(jax.vmap(fingerprint))(state))
+
+    def check_determinism(self, seed: int, max_steps: int) -> bool:
+        """Run the same seed twice and bitwise-compare final state — the
+        enable_determinism_check analog (runtime/mod.rs:144-187), but over
+        the full state rather than the RNG draw log."""
+        s1, _ = self.run(self.init_single(seed), max_steps,
+                         collect_events=False)
+        s2, _ = self.run(self.init_single(seed), max_steps,
+                         collect_events=False)
+        return bool((self.fingerprints(s1) == self.fingerprints(s2)).all())

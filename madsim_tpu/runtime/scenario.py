@@ -1,0 +1,154 @@
+"""Scenario scripts: the supervisor future, compiled to data.
+
+In madsim the supervisor is an async future on node 0 that sleeps to
+checkpoints and calls `Handle::{kill, restart, pause, resume}` /
+`NetSim::{clog_node, clog_link, ...}` (runtime/mod.rs:200-256,
+net/mod.rs:98-157). Keeping that imperative loop on the host would force a
+device sync per fault. Instead a Scenario is a static table of scheduled
+supervisor ops baked into the initial event table, so fault injection happens
+*inside* the jitted trace at full speed — and ops may take NODE_RANDOM
+targets, resolved per-trajectory from the seed's PRNG, which is how one
+scenario fuzzes thousands of distinct fault schedules at once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..core import types as T
+
+
+@dataclasses.dataclass
+class _Row:
+    time: int
+    op: int
+    node: int = 0
+    src: int = 0
+    payload: tuple = ()
+
+
+class Scenario:
+    """Builder for scheduled supervisor ops.
+
+    Example (a MadRaft-style chaos schedule)::
+
+        sc = Scenario()
+        sc.at(T.sec(1)).partition([0, 1])        # cut {0,1} from the rest
+        sc.at(T.sec(2)).heal()
+        for t in range(5):
+            sc.at(T.sec(3 + t)).kill_random()    # per-seed random victim
+            sc.at(T.sec(3 + t) + T.ms(500)).restart_random()
+        sc.at(T.sec(10)).halt()
+    """
+
+    def __init__(self):
+        self.rows: list[_Row] = []
+
+    # -- time cursor -------------------------------------------------------
+    def at(self, time: int) -> "_At":
+        return _At(self, int(time))
+
+    def has_halt(self) -> bool:
+        return any(r.op == T.OP_HALT for r in self.rows)
+
+    def build(self, cfg: T.SimConfig):
+        """-> dict of numpy arrays (time, op, node, src, payload[R, P])."""
+        R = len(self.rows)
+        P = cfg.payload_words
+        out = dict(
+            time=np.zeros(R, np.int32), op=np.zeros(R, np.int32),
+            node=np.zeros(R, np.int32), src=np.zeros(R, np.int32),
+            payload=np.zeros((R, P), np.int32),
+        )
+        for i, r in enumerate(self.rows):
+            if len(r.payload) > P:
+                raise ValueError(
+                    f"scenario op {r.op} at t={r.time} needs "
+                    f"{len(r.payload)} payload words but cfg.payload_words="
+                    f"{P} (partition masks pack 31 nodes per word)")
+            out["time"][i] = r.time
+            out["op"][i] = r.op
+            out["node"][i] = r.node
+            out["src"][i] = r.src
+            for j, w in enumerate(r.payload):
+                out["payload"][i, j] = w
+        return out
+
+
+class _At:
+    def __init__(self, sc: Scenario, time: int):
+        self._sc, self._t = sc, time
+
+    def _add(self, op, node=0, src=0, payload=()):
+        self._sc.rows.append(_Row(self._t, op, int(node), int(src),
+                                  tuple(payload)))
+        return self
+
+    # -- node lifecycle (Handle::kill/restart/pause/resume) ----------------
+    def kill(self, node):
+        return self._add(T.OP_KILL, node)
+
+    def restart(self, node):
+        return self._add(T.OP_RESTART, node)
+
+    def pause(self, node):
+        return self._add(T.OP_PAUSE, node)
+
+    def resume(self, node):
+        return self._add(T.OP_RESUME, node)
+
+    def kill_random(self):
+        """Kill a random alive node — target drawn per-seed at fire time."""
+        return self._add(T.OP_KILL, T.NODE_RANDOM)
+
+    def restart_random(self):
+        """Restart a random dead node."""
+        return self._add(T.OP_RESTART, T.NODE_RANDOM)
+
+    def pause_random(self):
+        return self._add(T.OP_PAUSE, T.NODE_RANDOM)
+
+    def resume_random(self):
+        return self._add(T.OP_RESUME, T.NODE_RANDOM)
+
+    # -- network faults (NetSim) ------------------------------------------
+    def clog_node(self, node):
+        return self._add(T.OP_CLOG_NODE, node)
+
+    def unclog_node(self, node):
+        return self._add(T.OP_UNCLOG_NODE, node)
+
+    def clog_node_random(self):
+        return self._add(T.OP_CLOG_NODE, T.NODE_RANDOM)
+
+    def clog_link(self, src, dst):
+        return self._add(T.OP_CLOG_LINK, dst, src)
+
+    def unclog_link(self, src, dst):
+        return self._add(T.OP_UNCLOG_LINK, dst, src)
+
+    def partition(self, group_a):
+        """Cut group_a <-> everyone else, both directions (disconnect2 x N^2
+        collapsed into one op). Membership is packed 31 nodes per payload
+        word (sign bit unused), so up to 31 * payload_words nodes."""
+        words = [0] * (1 + max((int(n) for n in group_a), default=0) // 31)
+        for n in group_a:
+            n = int(n)
+            words[n // 31] |= 1 << (n % 31)
+        return self._add(T.OP_PARTITION, payload=tuple(words))
+
+    def heal(self):
+        """Clear all clogs/partitions."""
+        return self._add(T.OP_HEAL)
+
+    def set_loss(self, rate: float):
+        return self._add(T.OP_SET_LOSS, payload=(int(rate * 1e6),))
+
+    def set_latency(self, lo: int, hi: int):
+        return self._add(T.OP_SET_LATENCY, payload=(int(lo), int(hi)))
+
+    # -- end of simulation -------------------------------------------------
+    def halt(self):
+        return self._add(T.OP_HALT)

@@ -1,0 +1,46 @@
+"""Per-trajectory state fingerprints.
+
+madsim's nondeterminism detector logs `hash(rng_byte ^ time)` at every RNG
+draw and compares across two same-seed runs (rand.rs:72-96,
+runtime/mod.rs:144-187). Because our whole cluster state is one pytree of
+tensors, the equivalent check is cheaper and stronger: fold every state leaf
+into a 32-bit fingerprint per trajectory and compare across replays — any
+divergence anywhere in the state is caught, not just RNG draw order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+FNV_OFFSET = jnp.uint32(2166136261)
+FNV_PRIME = jnp.uint32(16777619)
+
+
+def _leaf_words(a: jax.Array) -> jax.Array:
+    """View a leaf as a flat uint32 vector (value-stable encoding)."""
+    if a.dtype == jnp.float32:
+        w = lax.bitcast_convert_type(a, jnp.uint32)
+    elif a.dtype in (jnp.uint32,):
+        w = a
+    else:
+        w = a.astype(jnp.int32).astype(jnp.uint32)
+    return w.reshape(-1)
+
+
+def fingerprint(state) -> jax.Array:
+    """uint32 fingerprint of one trajectory's full state pytree.
+
+    vmap this for a batched state. Deterministic given identical values and
+    identical pytree structure/shapes.
+    """
+    leaves = jax.tree.leaves(state)
+    h = FNV_OFFSET
+    for i, leaf in enumerate(leaves):
+        w = _leaf_words(jnp.asarray(leaf))
+        mix = jnp.arange(w.shape[0], dtype=jnp.uint32) * jnp.uint32(
+            2654435761) + jnp.uint32(2 * i + 1)
+        lh = jnp.sum(w * mix, dtype=jnp.uint32) if w.shape[0] else jnp.uint32(0)
+        h = (h ^ lh) * FNV_PRIME
+    return h
