@@ -1,0 +1,96 @@
+"""Benchmark: MadRaft-style fuzz throughput, batched-TPU vs single-seed CPU.
+
+North star (BASELINE.md): simulated schedules/sec (seeds x events/s) on a
+5-node Raft cluster under chaos (kill/restart + partition/heal + packet
+loss). The reference publishes no numbers (BASELINE.md: its benches are CI
+infrastructure only) and its Rust toolchain is not in this image, so the
+baseline is the reference's *execution model* reproduced here: one seed
+advancing sequentially on one CPU core (the `cargo test` loop analog —
+jit-compiled, so this baseline is if anything generous). vs_baseline is
+batched-TPU seed-events/s over single-seed-CPU events/s.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+B_TPU = 4096        # seed batch on the TPU chip
+WARM = 128          # warmup steps (includes compile)
+STEPS = 1024        # timed steps
+CPU_STEPS = 512     # timed steps for the single-seed CPU baseline
+
+
+def _make_runtime():
+    from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
+    from madsim_tpu.models.raft import make_raft_runtime
+
+    n = 5
+    cfg = SimConfig(n_nodes=n, event_capacity=256, time_limit=sec(600),
+                    net=NetConfig(packet_loss_rate=0.05))
+    sc = Scenario()
+    for t in range(8):  # rolling chaos, one cycle per simulated second
+        sc.at(sec(1 + t)).kill_random()
+        sc.at(sec(1 + t) + ms(400)).restart_random()
+        sc.at(sec(1 + t) + ms(600)).partition([t % n, (t + 1) % n])
+        sc.at(sec(1 + t) + ms(900)).heal()
+    return make_raft_runtime(n, log_capacity=32, n_cmds=24, scenario=sc,
+                             cfg=cfg)
+
+
+def _events_per_sec(batch: int, steps: int, warm: int) -> float:
+    import jax
+    rt = _make_runtime()
+    state = rt.init_batch(np.arange(batch))
+    runner = rt._run_chunk[False]
+    # warmup with the SAME static chunk length as the timed region, so the
+    # timed region measures execution, not a recompile
+    state, _ = runner(state, steps)
+    jax.block_until_ready(state.now)
+    t0 = time.perf_counter()
+    state, _ = runner(state, steps)
+    jax.block_until_ready(state.now)
+    dt = time.perf_counter() - t0
+    live = float(np.asarray(~state.halted).mean())
+    assert not bool(np.asarray(state.crashed).any()), "bench workload crashed"
+    assert live > 0.9, f"bench lanes went idle (live={live:.2f})"
+    return batch * steps / dt
+
+
+def main():
+    if "--cpu-baseline" in sys.argv:
+        # single-seed sequential loop on CPU: the reference execution model
+        print(_events_per_sec(1, CPU_STEPS, WARM))
+        return
+
+    # CPU baseline in a clean subprocess (this process owns the TPU)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disable TPU sitecustomize hook
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--cpu-baseline"],
+        capture_output=True, text=True, env=env, check=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    cpu_eps = float(out.stdout.strip().splitlines()[-1])
+    print(f"cpu single-seed baseline: {cpu_eps:,.0f} events/s",
+          file=sys.stderr)
+
+    tpu_eps = _events_per_sec(B_TPU, STEPS, WARM)
+    print(f"tpu batched ({B_TPU} seeds): {tpu_eps:,.0f} seed-events/s",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "madraft_fuzz_seed_events_per_sec",
+        "value": round(tpu_eps, 1),
+        "unit": "seed*events/s (5-node Raft, chaos scenario)",
+        "vs_baseline": round(tpu_eps / cpu_eps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

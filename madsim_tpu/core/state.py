@@ -99,9 +99,3 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any) -> SimState:
     )
 
 
-def tree_select(pred, on_true, on_false):
-    """Pytree select on a scalar predicate — freezes halted trajectories.
-
-    Inside the (per-trajectory) step `pred` is a scalar; vmap batches it.
-    """
-    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)

@@ -32,7 +32,7 @@ from ..ops import select as sel
 from . import prng
 from . import types as T
 from .api import Ctx, Program
-from .state import SimState, tree_select
+from .state import SimState
 
 
 def _where_tree(mask, new, old):
@@ -63,6 +63,8 @@ def make_step(
     node_prog: np.ndarray,
     state_spec: Any,
     invariant: Callable[[SimState], tuple[jax.Array, jax.Array]] | None = None,
+    persist: Any = None,
+    halt_when: Callable[[SimState], jax.Array] | None = None,
 ) -> Callable[[SimState], tuple[SimState, dict[str, jax.Array]]]:
     """Build the per-trajectory step function.
 
@@ -75,6 +77,13 @@ def make_step(
         evaluated after every dispatch (e.g. Raft election safety). This is
         strictly stronger than the reference, where the supervisor can only
         observe at its own wakeups.
+      persist: optional pytree of bools matching state_spec: True leaves are
+        STABLE STORAGE — they survive kill/restart (the FsSim analog,
+        fs.rs:66-122: files outlive the process; everything else is process
+        memory and resets on boot). None = all volatile.
+      halt_when: optional global success condition `f(state) -> bool`; when
+        True the trajectory halts cleanly (the "supervisor future returned"
+        analog of Runtime::block_on resolving).
     """
     node_prog = np.asarray(node_prog, np.int32)
     assert node_prog.shape == (cfg.n_nodes,)
@@ -82,9 +91,19 @@ def make_step(
     node_prog_j = jnp.asarray(node_prog)
     P = cfg.payload_words
     spec_default = jax.tree.map(lambda a: jnp.asarray(a), state_spec)
+    if persist is None:
+        persist_mask = jax.tree.map(lambda a: False, spec_default)
+    else:
+        persist_mask = persist
+        assert (jax.tree.structure(persist_mask)
+                == jax.tree.structure(spec_default)), \
+            "persist mask must match state_spec structure"
 
     def live_step(s: SimState):
+        live = ~s.halted  # frozen trajectories no-op via mask gating (the
+        # vmap-friendly alternative to freezing with a whole-tree select)
         key, k_sched, k_super, k_handler, k_net = prng.split(s.key, 5)
+        key = jnp.where(live, key, s.key)
 
         # ---- 1. pick next event: earliest eligible deadline, random tie-break
         occupied = s.t_kind != T.EV_FREE
@@ -94,10 +113,11 @@ def make_step(
         eligible = occupied & ~parked
         dmin, at_min, any_ev = sel.min_deadline(s.t_deadline, eligible, T.T_INF)
         idx, picked = sel.masked_choice(k_sched, at_min)
-        valid = picked & any_ev
+        valid = picked & any_ev & live
 
         ev_kind = jnp.where(valid, s.t_kind[idx], T.EV_FREE)
-        ev_node = jnp.clip(s.t_node[idx], 0, cfg.n_nodes - 1)
+        ev_node_raw = s.t_node[idx]  # may be NODE_RANDOM for supervisor ops
+        ev_node = jnp.clip(ev_node_raw, 0, cfg.n_nodes - 1)
         ev_src = s.t_src[idx]
         ev_tag = s.t_tag[idx]
         ev_payload = s.t_payload[idx]
@@ -120,8 +140,8 @@ def make_step(
         # ---- 2. supervisor op (Handle::kill/restart/... as events) ---------
         is_super = valid & (ev_kind == T.EV_SUPER)
         op = jnp.where(is_super, ev_tag, 0)
-        s, init_node = _apply_super(cfg, spec_default, s, op, ev_node, ev_src,
-                                    ev_payload, k_super)
+        s, init_node = _apply_super(cfg, spec_default, persist_mask, s, op,
+                                    ev_node_raw, ev_src, ev_payload, k_super)
 
         # ---- 3. protocol handler dispatch ---------------------------------
         node_ok = s.alive[ev_node] & ~s.paused[ev_node]
@@ -172,54 +192,70 @@ def make_step(
             node_state=_scatter_node(s.node_state, h_node, new_slice, any_h))
 
         # ---- 4. materialize emissions into the event table ----------------
-        free = s.t_kind == T.EV_FREE
-        slots, slot_ok = sel.first_k_free(free, n_sends + n_timers)
-        overflow = jnp.asarray(False)
-        t_deadline, t_kind = s.t_deadline, s.t_kind
-        t_node, t_src, t_tag, t_payload = s.t_node, s.t_src, s.t_tag, s.t_payload
-        net_keys = prng.split(k_net, 2 * max(n_sends, 1))
+        # All emissions are staged into [E]-vectors and written with ONE
+        # gather+scatter per table column (slots are distinct by
+        # construction), instead of E separate dynamic-index updates — the
+        # difference between ~6 and ~6*E scatter ops per step on TPU.
+        E = n_sends + n_timers
         sent = delivered_drop = jnp.asarray(0, jnp.int32)
+        overflow = jnp.asarray(False)
+        if E > 0:
+            free = s.t_kind == T.EV_FREE
+            slots, slot_ok = sel.first_k_free(free, E)
+            net_keys = prng.split(k_net, 2 * max(n_sends, 1))
+            em_write, em_deadline, em_kind = [], [], []
+            em_node, em_tag, em_payload = [], [], []
 
-        for j, e in enumerate(sends):
-            dst = jnp.clip(e["dst"], 0, cfg.n_nodes - 1)
-            # network fault model: clog + loss + latency (network.rs:222-229)
-            clogged = (s.clog_node[h_node] | s.clog_node[dst]
-                       | s.clog_link[h_node, dst])
-            lost = prng.bernoulli(net_keys[2 * j], s.loss)
-            latency = prng.randint(net_keys[2 * j + 1], s.lat_lo, s.lat_hi)
-            ok = e["m"] & ~clogged & ~lost
-            sent = sent + e["m"].astype(jnp.int32)
-            delivered_drop = delivered_drop + (e["m"] & ~ok).astype(jnp.int32)
-            slot, sok = slots[j], slot_ok[j]
-            write = ok & sok
-            overflow = overflow | (ok & ~sok)
-            t_deadline = t_deadline.at[slot].set(
-                jnp.where(write, s.now + latency, t_deadline[slot]))
-            t_kind = t_kind.at[slot].set(
-                jnp.where(write, T.EV_MSG, t_kind[slot]))
-            t_node = t_node.at[slot].set(jnp.where(write, dst, t_node[slot]))
-            t_src = t_src.at[slot].set(jnp.where(write, h_node, t_src[slot]))
-            t_tag = t_tag.at[slot].set(jnp.where(write, e["tag"], t_tag[slot]))
-            t_payload = t_payload.at[slot].set(
-                jnp.where(write, e["payload"], t_payload[slot]))
+            for j, e in enumerate(sends):
+                dst = jnp.clip(e["dst"], 0, cfg.n_nodes - 1)
+                # network fault model: clog + loss + latency
+                # (network.rs:222-229)
+                clogged = (s.clog_node[h_node] | s.clog_node[dst]
+                           | s.clog_link[h_node, dst])
+                lost = prng.bernoulli(net_keys[2 * j], s.loss)
+                latency = prng.randint(net_keys[2 * j + 1], s.lat_lo, s.lat_hi)
+                ok = e["m"] & ~clogged & ~lost
+                sent = sent + e["m"].astype(jnp.int32)
+                delivered_drop = delivered_drop + (e["m"] & ~ok).astype(
+                    jnp.int32)
+                write = ok & slot_ok[j]
+                overflow = overflow | (ok & ~slot_ok[j])
+                em_write.append(write)
+                em_deadline.append(s.now + latency)
+                em_kind.append(jnp.asarray(T.EV_MSG, jnp.int32))
+                em_node.append(dst)
+                em_tag.append(e["tag"])
+                em_payload.append(e["payload"])
 
-        for j, e in enumerate(timers):
-            slot, sok = slots[n_sends + j], slot_ok[n_sends + j]
-            write = e["m"] & sok
-            overflow = overflow | (e["m"] & ~sok)
-            t_deadline = t_deadline.at[slot].set(
-                jnp.where(write, s.now + e["delay"], t_deadline[slot]))
-            t_kind = t_kind.at[slot].set(
-                jnp.where(write, T.EV_TIMER, t_kind[slot]))
-            t_node = t_node.at[slot].set(jnp.where(write, h_node, t_node[slot]))
-            t_src = t_src.at[slot].set(jnp.where(write, h_node, t_src[slot]))
-            t_tag = t_tag.at[slot].set(jnp.where(write, e["tag"], t_tag[slot]))
-            t_payload = t_payload.at[slot].set(
-                jnp.where(write, e["payload"], t_payload[slot]))
+            for j, e in enumerate(timers):
+                write = e["m"] & slot_ok[n_sends + j]
+                overflow = overflow | (e["m"] & ~slot_ok[n_sends + j])
+                em_write.append(write)
+                em_deadline.append(s.now + e["delay"])
+                em_kind.append(jnp.asarray(T.EV_TIMER, jnp.int32))
+                em_node.append(h_node)
+                em_tag.append(e["tag"])
+                em_payload.append(e["payload"])
+
+            w = jnp.stack(em_write)                      # [E] bool
+            # masked-off emissions scatter out of bounds and are dropped —
+            # real slots are distinct, so the scatter has no index clashes
+            slots_eff = jnp.where(w, slots,
+                                  jnp.asarray(cfg.event_capacity, jnp.int32))
+
+            def put(col, vals):
+                return col.at[slots_eff].set(jnp.stack(vals), mode="drop")
+
+            s = s.replace(
+                t_deadline=put(s.t_deadline, em_deadline),
+                t_kind=put(s.t_kind, em_kind),
+                t_node=put(s.t_node, em_node),
+                t_src=put(s.t_src, [h_node] * E),
+                t_tag=put(s.t_tag, em_tag),
+                t_payload=put(s.t_payload, em_payload),
+            )
 
         s = s.replace(
-            t_deadline=t_deadline, t_kind=t_kind, t_node=t_node, t_src=t_src,
-            t_tag=t_tag, t_payload=t_payload,
             msg_sent=s.msg_sent + sent,
             msg_delivered=s.msg_delivered + is_msg.astype(jnp.int32),
             msg_dropped=s.msg_dropped + delivered_drop
@@ -227,20 +263,25 @@ def make_step(
             oops=s.oops | jnp.where(overflow, T.OOPS_EVENT_OVERFLOW, 0)
             | jnp.where(s.now > T.T_INF - 64 * T.TICKS_PER_SEC,
                         T.OOPS_TIME_OVERFLOW, 0),
-            steps=s.steps + 1,
+            steps=s.steps + valid.astype(jnp.int32),
         )
 
         # ---- 5. end conditions -------------------------------------------
         # deadlock: nothing can ever run again (madsim task.rs:116 panic)
-        crash = crash | ~any_ev | time_over
+        crash = crash | ((~any_ev | time_over) & live)
         crash_code = jnp.where(
-            ~any_ev, T.CRASH_DEADLOCK,
-            jnp.where(time_over & (crash_code == 0), T.CRASH_TIME_LIMIT,
-                      crash_code))
+            ~any_ev & live, T.CRASH_DEADLOCK,
+            jnp.where(time_over & live & (crash_code == 0),
+                      T.CRASH_TIME_LIMIT, crash_code))
         halted_now = halt_req | (is_super & (op == T.OP_HALT))
+        if halt_when is not None:
+            # global success condition (the root-future-ready analog): e.g.
+            # "all clients acked" — has whole-cluster visibility
+            halted_now = halted_now | (halt_when(s) & live)
 
         if invariant is not None:
             bad, code = invariant(s)
+            bad = bad & live
             first = bad & ~crash
             crash_code = jnp.where(first, code, crash_code)
             crash = crash | bad
@@ -261,16 +302,11 @@ def make_step(
         )
         return s, record
 
-    def step(s: SimState):
-        ns, record = live_step(s)
-        out = tree_select(s.halted, s, ns)
-        record = dict(record, fired=record["fired"] & ~s.halted)
-        return out, record
-
-    return step
+    return live_step
 
 
-def _apply_super(cfg, spec_default, s: SimState, op, node, src, payload, key):
+def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
+                 payload, key):
     """Apply one supervisor opcode as masked state edits.
 
     Returns (state, init_node) where init_node >= 0 requests the program
@@ -318,8 +354,12 @@ def _apply_super(cfg, spec_default, s: SimState, op, node, src, payload, key):
                   jnp.where(when(op == T.OP_PAUSE), True, s.paused[target])))
 
     # node boot/restart resets protocol state to the spec default — process
-    # memory does not survive a crash
-    node_state = _scatter_node(s.node_state, target, spec_default, boot)
+    # memory does not survive a crash. Leaves marked persistent are stable
+    # storage (the FsSim analog) and DO survive.
+    node_state = jax.tree.map(
+        lambda full, dflt, keep: full if keep else full.at[target].set(
+            jnp.where(boot, dflt, full[target])),
+        s.node_state, spec_default, persist_mask)
 
     clog_node = s.clog_node.at[target].set(
         jnp.where(when(op == T.OP_CLOG_NODE), True,

@@ -1,0 +1,330 @@
+"""Raft — the MadRaft-equivalent flagship workload (BASELINE.md configs 2/4).
+
+A full Raft core (leader election + log replication + commit) written as a
+vectorizable state machine: every handler is straight-line jnp arithmetic
+with masks, so thousands of 5-node Raft clusters fuzz in lockstep on one
+chip. term/votedFor/log live in stable storage (the engine's persist mask —
+the FsSim analog), so kill/restart chaos exercises real Raft durability
+semantics rather than amnesiac restarts.
+
+Safety is checked EVERY event by a global invariant (something the reference
+architecture cannot do cheaply — its supervisor only observes at its own
+wakeups): Election Safety (at most one leader per term) and State Machine
+Safety (committed prefixes never disagree).
+
+Message schema (payload words):
+  RV : [term, last_log_len, last_log_term]          RequestVote
+  RVR: [term, granted]                               RequestVote reply
+  AE : [term, prev_len, prev_term, leader_commit,    AppendEntries
+        entry_term, entry_cmd, has_entry]            (one entry per message)
+  AER: [term, success, match_len]                    AppendEntries reply
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.api import Ctx, Program
+from ..core.types import ms
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+# message tags
+RV, RVR, AE, AER = 1, 2, 3, 4
+# timer tags
+T_ELECTION, T_HEARTBEAT, T_PROPOSE = 1, 2, 3
+
+# crash codes (invariant violations)
+CRASH_TWO_LEADERS = 101
+CRASH_LOG_MISMATCH = 102
+CRASH_COMMIT_GT_LOG = 103
+
+
+def state_spec(n_nodes: int, log_capacity: int = 32):
+    z = jnp.asarray(0, jnp.int32)
+    L, N = log_capacity, n_nodes
+    return dict(
+        # persistent (stable storage — survives kill/restart)
+        term=z,
+        voted_for=jnp.asarray(-1, jnp.int32),
+        log_term=jnp.zeros((L,), jnp.int32),
+        log_cmd=jnp.zeros((L,), jnp.int32),
+        log_len=z,
+        # volatile
+        role=z,
+        votes=z,
+        commit=z,
+        next_idx=jnp.zeros((N,), jnp.int32),
+        match_idx=jnp.zeros((N,), jnp.int32),
+        egen=z,      # election-timer generation (stale-timer filter)
+        hgen=z,      # heartbeat-timer generation
+        nprop=z,     # proposals issued by this node while leader
+    )
+
+
+def persist_spec():
+    """Which leaves are stable storage (Raft Figure 2 'persistent state')."""
+    return dict(
+        term=True, voted_for=True, log_term=True, log_cmd=True, log_len=True,
+        role=False, votes=False, commit=False, next_idx=False,
+        match_idx=False, egen=False, hgen=False, nprop=False,
+    )
+
+
+class Raft(Program):
+    """One Raft peer. All nodes run this program.
+
+    Args:
+      n_nodes: cluster size (majority = n//2 + 1).
+      log_capacity: max entries (static shape).
+      n_cmds: proposals each leader stint will issue (self-proposing client).
+      halt_on_commit: halt the trajectory when any node's commit index
+        reaches this (0 = run to the scenario's HALT).
+    """
+
+    def __init__(self, n_nodes: int, log_capacity: int = 32,
+                 n_cmds: int = 8, halt_on_commit: int = 0,
+                 election_min=ms(150), election_max=ms(300),
+                 heartbeat_every=ms(50), propose_every=ms(100),
+                 majority_override: int | None = None):
+        self.n = n_nodes
+        self.L = log_capacity
+        self.n_cmds = n_cmds
+        self.halt_on_commit = halt_on_commit
+        self.emin, self.emax = election_min, election_max
+        self.hb = heartbeat_every
+        self.prop = propose_every
+        # test hook: an intentionally wrong quorum size lets the test suite
+        # prove the invariant checker catches real protocol bugs
+        self.majority = (majority_override if majority_override is not None
+                         else n_nodes // 2 + 1)
+
+    # -- helpers ----------------------------------------------------------
+    def _last_term(self, st):
+        return jnp.where(st["log_len"] > 0,
+                         st["log_term"][jnp.clip(st["log_len"] - 1, 0,
+                                                 self.L - 1)], 0)
+
+    def _arm_election(self, ctx, st, when):
+        st["egen"] = st["egen"] + jnp.asarray(when, jnp.int32)
+        ctx.set_timer(ctx.randint(self.emin, self.emax), T_ELECTION,
+                      [st["egen"]], when=when)
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self, ctx: Ctx):
+        st = dict(ctx.state)  # persistent leaves carry over from before
+        self._arm_election(ctx, st, True)
+        ctx.set_timer(ctx.randint(0, self.prop), T_PROPOSE, [0])
+        ctx.state = st
+
+    # -- timers -----------------------------------------------------------
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        N, L = self.n, self.L
+
+        # election timeout: become candidate, solicit votes (Raft §5.2)
+        is_el = ((tag == T_ELECTION) & (payload[0] == st["egen"])
+                 & (st["role"] != LEADER))
+        st["term"] = st["term"] + is_el
+        st["role"] = jnp.where(is_el, CANDIDATE, st["role"])
+        st["voted_for"] = jnp.where(is_el, ctx.node, st["voted_for"])
+        st["votes"] = jnp.where(is_el, 1, st["votes"])
+        last_t = self._last_term(st)
+        for p in range(N):
+            ctx.send(p, RV, [st["term"], st["log_len"], last_t],
+                     when=is_el & (p != ctx.node))
+        self._arm_election(ctx, st, is_el)  # candidate retries on split vote
+
+        # heartbeat / replication tick (leader only)
+        is_hb = ((tag == T_HEARTBEAT) & (payload[0] == st["hgen"])
+                 & (st["role"] == LEADER))
+        for p in range(N):
+            nxt = st["next_idx"][p]
+            has = nxt < st["log_len"]
+            prev_term = jnp.where(nxt > 0,
+                                  st["log_term"][jnp.clip(nxt - 1, 0, L - 1)],
+                                  0)
+            eidx = jnp.clip(nxt, 0, L - 1)
+            ctx.send(p, AE,
+                     [st["term"], nxt, prev_term, st["commit"],
+                      st["log_term"][eidx], st["log_cmd"][eidx],
+                      has.astype(jnp.int32)],
+                     when=is_hb & (p != ctx.node))
+        ctx.set_timer(self.hb, T_HEARTBEAT, [st["hgen"]], when=is_hb)
+
+        # self-proposing client: leaders append a fresh command
+        is_pr = tag == T_PROPOSE
+        can = (is_pr & (st["role"] == LEADER) & (st["log_len"] < L)
+               & (st["nprop"] < self.n_cmds))
+        widx = jnp.clip(st["log_len"], 0, L - 1)
+        cmd = ctx.node * 65536 + st["nprop"]
+        st["log_term"] = st["log_term"].at[widx].set(
+            jnp.where(can, st["term"], st["log_term"][widx]))
+        st["log_cmd"] = st["log_cmd"].at[widx].set(
+            jnp.where(can, cmd, st["log_cmd"][widx]))
+        st["log_len"] = st["log_len"] + can
+        st["nprop"] = st["nprop"] + can
+        st["match_idx"] = st["match_idx"].at[ctx.node].set(
+            jnp.where(can, st["log_len"], st["match_idx"][ctx.node]))
+        ctx.set_timer(self.prop, T_PROPOSE, [0], when=is_pr)
+
+        if self.halt_on_commit:
+            ctx.halt_if(st["commit"] >= self.halt_on_commit)
+        ctx.state = st
+
+    # -- messages ---------------------------------------------------------
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        N, L = self.n, self.L
+        majority = self.majority
+        term_in = payload[0]
+
+        # any message with a higher term: step down (Raft §5.1)
+        higher = term_in > st["term"]
+        st["term"] = jnp.where(higher, term_in, st["term"])
+        st["role"] = jnp.where(higher, FOLLOWER, st["role"])
+        st["voted_for"] = jnp.where(higher, -1, st["voted_for"])
+
+        # ---- RequestVote (§5.2, §5.4.1 up-to-date check) ----------------
+        is_rv = tag == RV
+        cand_len, cand_last_t = payload[1], payload[2]
+        my_last_t = self._last_term(st)
+        log_ok = ((cand_last_t > my_last_t)
+                  | ((cand_last_t == my_last_t) & (cand_len >= st["log_len"])))
+        grant = (is_rv & (term_in == st["term"]) & log_ok
+                 & ((st["voted_for"] == -1) | (st["voted_for"] == src)))
+        st["voted_for"] = jnp.where(grant, src, st["voted_for"])
+        ctx.send(src, RVR, [st["term"], grant.astype(jnp.int32)], when=is_rv)
+
+        # ---- RequestVote reply ------------------------------------------
+        is_rvr = ((tag == RVR) & (st["role"] == CANDIDATE)
+                  & (term_in == st["term"]) & (payload[1] == 1))
+        st["votes"] = st["votes"] + is_rvr
+        become_leader = is_rvr & (st["votes"] == majority)  # fires exactly once
+        st["role"] = jnp.where(become_leader, LEADER, st["role"])
+        st["next_idx"] = jnp.where(become_leader,
+                                   jnp.full((N,), 1, jnp.int32)
+                                   * st["log_len"], st["next_idx"])
+        st["match_idx"] = jnp.where(
+            become_leader,
+            jnp.zeros((N,), jnp.int32).at[ctx.node].set(st["log_len"]),
+            st["match_idx"])
+        st["hgen"] = st["hgen"] + become_leader
+        ctx.set_timer(0, T_HEARTBEAT, [st["hgen"]], when=become_leader)
+
+        # ---- AppendEntries (§5.3) ---------------------------------------
+        is_ae = tag == AE
+        prev, prev_t = payload[1], payload[2]
+        lcommit, e_term, e_cmd = payload[3], payload[4], payload[5]
+        has = payload[6] == 1
+        from_leader = is_ae & (term_in == st["term"])
+        # a candidate discovering the elected leader returns to follower
+        st["role"] = jnp.where(from_leader & (st["role"] == CANDIDATE),
+                               FOLLOWER, st["role"])
+        prev_ok = (prev == 0) | ((prev <= st["log_len"])
+                                 & (st["log_term"][jnp.clip(prev - 1, 0,
+                                                            L - 1)] == prev_t))
+        ok = from_leader & prev_ok & (~has | (prev < L))
+        conflict = has & (prev < st["log_len"]) & (
+            st["log_term"][jnp.clip(prev, 0, L - 1)] != e_term)
+        widx = jnp.clip(prev, 0, L - 1)
+        write = ok & has
+        st["log_term"] = st["log_term"].at[widx].set(
+            jnp.where(write, e_term, st["log_term"][widx]))
+        st["log_cmd"] = st["log_cmd"].at[widx].set(
+            jnp.where(write, e_cmd, st["log_cmd"][widx]))
+        new_len = jnp.where(
+            write, jnp.where(conflict, prev + 1,
+                             jnp.maximum(st["log_len"], prev + 1)),
+            st["log_len"])
+        st["log_len"] = new_len
+        match = jnp.where(ok, prev + write, 0)
+        st["commit"] = jnp.where(
+            ok, jnp.maximum(st["commit"], jnp.minimum(lcommit, new_len)),
+            st["commit"])
+        ctx.send(src, AER,
+                 [st["term"], ok.astype(jnp.int32), match], when=is_ae)
+
+        # ---- AppendEntries reply (leader side) --------------------------
+        is_aer = ((tag == AER) & (st["role"] == LEADER)
+                  & (term_in == st["term"]))
+        succ = payload[1] == 1
+        mlen = payload[2]
+        new_match = jnp.where(is_aer & succ,
+                              jnp.maximum(st["match_idx"][src], mlen),
+                              st["match_idx"][src])
+        st["match_idx"] = st["match_idx"].at[src].set(new_match)
+        st["next_idx"] = st["next_idx"].at[src].set(
+            jnp.where(is_aer & succ, jnp.maximum(st["next_idx"][src],
+                                                 new_match),
+                      jnp.where(is_aer & ~succ,
+                                jnp.maximum(st["next_idx"][src] - 1, 0),
+                                st["next_idx"][src])))
+        # advance commit: majority-replicated entries of the current term
+        # (§5.4.2 — never commit prior-term entries by counting)
+        ks = jnp.arange(L, dtype=jnp.int32)
+        replicated = (st["match_idx"][None, :] >= ks[:, None] + 1)  # [L, N]
+        cnt = replicated.sum(axis=1)
+        committable = ((cnt >= majority) & (ks < st["log_len"])
+                       & (st["log_term"] == st["term"]))
+        best = jnp.max(jnp.where(committable, ks + 1, 0))
+        st["commit"] = jnp.where(is_aer,
+                                 jnp.maximum(st["commit"], best), st["commit"])
+
+        # ---- election timer reset (vote granted or live leader heard) ---
+        self._arm_election(ctx, st, grant | from_leader)
+        if self.halt_on_commit:
+            ctx.halt_if(st["commit"] >= self.halt_on_commit)
+        ctx.state = st
+
+
+def raft_invariant(n_nodes: int, log_capacity: int = 32):
+    """Global safety checks, evaluated after every event.
+
+    Election Safety: at most one leader per term — the task.rs analog would
+    be MadRaft's test asserting one leader (this is the §5.2 property).
+    State Machine Safety: committed prefixes agree pairwise (§5.4.3).
+    """
+    N, L = n_nodes, log_capacity
+    eye = jnp.eye(N, dtype=bool)
+
+    def invariant(state):
+        ns = state.node_state
+        role, term = ns["role"], ns["term"]
+        leader = role == LEADER
+        same_term = term[:, None] == term[None, :]
+        two_leaders = (leader[:, None] & leader[None, :] & same_term
+                       & ~eye).any()
+
+        commit = ns["commit"]
+        both_committed = jnp.minimum(commit[:, None], commit[None, :])  # [N,N]
+        ks = jnp.arange(L, dtype=jnp.int32)
+        in_prefix = ks[None, None, :] < both_committed[:, :, None]  # [N,N,L]
+        cmd_neq = ns["log_cmd"][:, None, :] != ns["log_cmd"][None, :, :]
+        term_neq = ns["log_term"][:, None, :] != ns["log_term"][None, :, :]
+        mismatch = (in_prefix & (cmd_neq | term_neq)).any()
+
+        commit_gt = (commit > ns["log_len"]).any()
+
+        bad = two_leaders | mismatch | commit_gt
+        code = jnp.where(
+            two_leaders, CRASH_TWO_LEADERS,
+            jnp.where(mismatch, CRASH_LOG_MISMATCH, CRASH_COMMIT_GT_LOG))
+        return bad, code
+
+    return invariant
+
+
+def make_raft_runtime(n_nodes=5, log_capacity=32, n_cmds=8,
+                      halt_on_commit=0, scenario=None, cfg=None, **raft_kw):
+    """Convenience constructor for a Raft fuzzing runtime."""
+    from ..core.types import SimConfig, sec
+    from ..runtime.runtime import Runtime
+    if cfg is None:
+        cfg = SimConfig(n_nodes=n_nodes, event_capacity=256,
+                        time_limit=sec(10))
+    prog = Raft(n_nodes, log_capacity, n_cmds, halt_on_commit, **raft_kw)
+    return Runtime(cfg, [prog], state_spec(n_nodes, log_capacity),
+                   scenario=scenario,
+                   invariant=raft_invariant(n_nodes, log_capacity),
+                   persist=persist_spec())

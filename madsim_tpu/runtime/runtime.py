@@ -44,7 +44,9 @@ class Runtime:
     def __init__(self, cfg: T.SimConfig, programs: Sequence[Program],
                  state_spec: Any, node_prog=None,
                  scenario: Scenario | None = None,
-                 invariant: Callable | None = None):
+                 invariant: Callable | None = None,
+                 persist: Any = None,
+                 halt_when: Callable | None = None):
         self.cfg = cfg
         self.programs = list(programs)
         self.state_spec = state_spec
@@ -60,7 +62,8 @@ class Runtime:
             self.scenario.at(cfg.time_limit).halt()
         self.invariant = invariant
         self._step = make_step(cfg, self.programs, self.node_prog,
-                               self.state_spec, invariant)
+                               self.state_spec, invariant, persist=persist,
+                               halt_when=halt_when)
         self._template = self._build_template()
 
     # ------------------------------------------------------------------
@@ -145,9 +148,9 @@ class Runtime:
         """Advance until every trajectory halts or ~max_steps events each
         (rounded up to a chunk multiple). Returns (state, events|None).
         """
-        # always run full chunks: halted trajectories are frozen by
-        # tree_select, so overshooting max_steps is free and avoids a second
-        # XLA compile for a partial tail chunk
+        # always run full chunks: halted trajectories are frozen by the
+        # live-mask gating inside the step, so overshooting max_steps is free
+        # and avoids a second XLA compile for a partial tail chunk
         runner = self._run_chunk[collect_events]
         events = [] if collect_events else None
         done = 0

@@ -1,8 +1,10 @@
-"""Test env: force a virtual 8-device CPU mesh before jax initializes.
+"""Test env: force a virtual 8-device CPU mesh before any test imports jax.
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a host-platform virtual mesh (the driver separately dry-runs
-the multi-chip path via __graft_entry__.dryrun_multichip).
+the multi-chip path via __graft_entry__.dryrun_multichip). The environment's
+sitecustomize may pre-register a TPU backend and pin jax_platforms, so the
+config update below (not just the env var) is what actually forces CPU.
 """
 
 import os
@@ -12,3 +14,7 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

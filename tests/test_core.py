@@ -183,3 +183,21 @@ class TestHarnessOops:
         rt = Runtime(cfg, [Bomb()], dict(x=jnp.asarray(0, jnp.int32)))
         state, _ = rt.run(rt.init_single(0), max_steps=200)
         assert int(np.asarray(state.oops)[0]) & T.OOPS_EVENT_OVERFLOW
+
+
+class TestRandomTargets:
+    def test_kill_random_varies_victim_across_seeds(self):
+        # regression: NODE_RANDOM must survive to the supervisor (a clip once
+        # collapsed it to node 0, degenerating all random faults)
+        from madsim_tpu import Scenario
+        from madsim_tpu.core.types import sec as _sec
+        n = 4
+        sc = Scenario()
+        sc.at(ms(5)).kill_random()
+        cfg = SimConfig(n_nodes=n, time_limit=_sec(1))
+        rt = Runtime(cfg, [PingPong(n, target=3)], state_spec(), scenario=sc)
+        state, _ = rt.run(rt.init_batch(np.arange(64)), max_steps=4000)
+        dead = np.asarray(~state.alive)
+        assert (dead.sum(axis=1) == 1).all()        # exactly one victim
+        victims = dead.argmax(axis=1)
+        assert len(set(victims.tolist())) >= 3      # victims vary by seed

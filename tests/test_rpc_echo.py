@@ -1,0 +1,51 @@
+"""RPC echo service tests — BASELINE.md config 3 (the tonic-example analog:
+server + clients, typed calls with retries, under loss and kill/restart)."""
+
+import numpy as np
+
+from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
+from madsim_tpu.harness.simtest import run_seeds
+from madsim_tpu.models.rpc_echo import make_echo_runtime
+
+SEEDS = np.arange(8)
+
+
+def _cfg(loss=0.0, time_limit=sec(20)):
+    return SimConfig(n_nodes=6, event_capacity=256, time_limit=time_limit,
+                     net=NetConfig(packet_loss_rate=loss,
+                                   send_latency_min=ms(1),
+                                   send_latency_max=ms(10)))
+
+
+class TestEcho:
+    def test_all_clients_complete(self):
+        rt = make_echo_runtime(n_nodes=6, target=10, cfg=_cfg())
+        state = run_seeds(rt, SEEDS, max_steps=10_000)
+        acked = np.asarray(state.node_state["acked"])
+        assert (acked[:, 1:] >= 10).all()
+        served = np.asarray(state.node_state["served"])[:, 0]
+        assert (served >= 50).all()  # 5 clients x 10 calls (>= for retries)
+        # halted via the global halt_when, before the time limit
+        assert (np.asarray(state.now) < sec(20)).all()
+
+    def test_completes_under_heavy_loss(self):
+        rt = make_echo_runtime(n_nodes=6, target=5, cfg=_cfg(loss=0.3))
+        state = run_seeds(rt, SEEDS, max_steps=40_000)
+        acked = np.asarray(state.node_state["acked"])
+        assert (acked[:, 1:] >= 5).all()
+        # at-least-once: retries mean the server served >= acked total
+        served = np.asarray(state.node_state["served"])[:, 0]
+        assert (served >= 25).all()
+
+    def test_server_kill_restart_midway(self):
+        # kill at 20ms: 16 sequential calls at >= 2ms RTT each cannot have
+        # completed yet, so every seed must ride out the dead window
+        sc = Scenario()
+        sc.at(ms(20)).kill(0)
+        sc.at(sec(2)).restart(0)
+        rt = make_echo_runtime(n_nodes=6, target=16, scenario=sc, cfg=_cfg())
+        state = run_seeds(rt, SEEDS, max_steps=40_000)
+        acked = np.asarray(state.node_state["acked"])
+        assert (acked[:, 1:] >= 16).all()
+        # the dead window forced client retries past the restart
+        assert (np.asarray(state.now) > sec(2)).all()
