@@ -319,7 +319,9 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     # resolve NODE_RANDOM targets (fuzzing): each op draws from the pool of
     # nodes it can meaningfully act on — kill/pause/clog a random alive node,
     # restart a random dead one, resume a random paused one, unclog a random
-    # clogged one
+    # clogged one. payload[0] optionally restricts candidates to a bitmask
+    # (31 nodes/word, word 0 only) so e.g. chaos kills target servers but
+    # not client/harness nodes.
     want_alive = (op == T.OP_KILL) | (op == T.OP_PAUSE) | (op == T.OP_CLOG_NODE)
     pool = jnp.where(want_alive, s.alive,
                      jnp.where(op == T.OP_RESTART, ~s.alive,
@@ -327,6 +329,10 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
                                          jnp.where(op == T.OP_UNCLOG_NODE,
                                                    s.clog_node,
                                                    jnp.ones((N,), bool)))))
+    ids = jnp.arange(N, dtype=jnp.int32)
+    in_pool = ((payload[0] >> jnp.clip(ids, 0, 30)) & 1) == 1
+    pool = pool & jnp.where(payload[0] != 0, in_pool & (ids < 31),
+                            jnp.ones((N,), bool))
     rnd, rnd_ok = sel.masked_choice(k_t, pool)
     is_random = node == T.NODE_RANDOM
     target = jnp.clip(jnp.where(is_random, rnd, node), 0, N - 1)

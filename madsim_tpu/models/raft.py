@@ -40,15 +40,19 @@ CRASH_LOG_MISMATCH = 102
 CRASH_COMMIT_GT_LOG = 103
 
 
-def state_spec(n_nodes: int, log_capacity: int = 32):
+def state_spec(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
+               extra=None):
+    """Node state schema. `fields` are the per-log-entry columns (the base
+    Raft carries one opaque command word; RaftKv carries op/key/val/client/
+    rtag). `extra` merges additional volatile leaves (e.g. client-side
+    bookkeeping in mixed clusters — all programs share one schema)."""
     z = jnp.asarray(0, jnp.int32)
     L, N = log_capacity, n_nodes
-    return dict(
+    spec = dict(
         # persistent (stable storage — survives kill/restart)
         term=z,
         voted_for=jnp.asarray(-1, jnp.int32),
         log_term=jnp.zeros((L,), jnp.int32),
-        log_cmd=jnp.zeros((L,), jnp.int32),
         log_len=z,
         # volatile
         role=z,
@@ -60,19 +64,37 @@ def state_spec(n_nodes: int, log_capacity: int = 32):
         hgen=z,      # heartbeat-timer generation
         nprop=z,     # proposals issued by this node while leader
     )
+    for f in fields:
+        spec[f"log_{f}"] = jnp.zeros((L,), jnp.int32)
+    if extra:
+        spec.update(extra)
+    return spec
 
 
-def persist_spec():
+def persist_spec(fields=("cmd",), extra=None):
     """Which leaves are stable storage (Raft Figure 2 'persistent state')."""
-    return dict(
-        term=True, voted_for=True, log_term=True, log_cmd=True, log_len=True,
+    mask = dict(
+        term=True, voted_for=True, log_term=True, log_len=True,
         role=False, votes=False, commit=False, next_idx=False,
         match_idx=False, egen=False, hgen=False, nprop=False,
     )
+    for f in fields:
+        mask[f"log_{f}"] = True
+    if extra:
+        mask.update({k: False for k in extra})
+    return mask
 
 
 class Raft(Program):
-    """One Raft peer. All nodes run this program.
+    """One Raft peer.
+
+    Subclass hooks (used by RaftKv in models/raft_kv.py):
+      ENTRY_FIELDS — per-log-entry int32 columns replicated via AE
+      _propose_fields(ctx, st) — entry values for the self-proposing client
+      _on_leader_commit(ctx, st, prev_commit, is_aer) — leader-side commit
+        advancement (e.g. replying to clients)
+      _extra_message(ctx, st, src, tag, payload) — extra message tags
+        (e.g. client requests)
 
     Args:
       n_nodes: cluster size (majority = n//2 + 1).
@@ -86,8 +108,12 @@ class Raft(Program):
                  n_cmds: int = 8, halt_on_commit: int = 0,
                  election_min=ms(150), election_max=ms(300),
                  heartbeat_every=ms(50), propose_every=ms(100),
-                 majority_override: int | None = None):
+                 majority_override: int | None = None,
+                 n_peers: int | None = None):
         self.n = n_nodes
+        # raft peers occupy nodes [0, n_peers); the rest of the cluster
+        # (e.g. KV clients) never votes, replicates, or receives broadcasts
+        self.npeers = n_peers if n_peers is not None else n_nodes
         self.L = log_capacity
         self.n_cmds = n_cmds
         self.halt_on_commit = halt_on_commit
@@ -97,7 +123,22 @@ class Raft(Program):
         # test hook: an intentionally wrong quorum size lets the test suite
         # prove the invariant checker catches real protocol bugs
         self.majority = (majority_override if majority_override is not None
-                         else n_nodes // 2 + 1)
+                         else self.npeers // 2 + 1)
+
+    ENTRY_FIELDS = ("cmd",)
+
+    # -- subclass hooks ---------------------------------------------------
+    def _propose_fields(self, ctx, st):
+        return {"cmd": ctx.node * 65536 + st["nprop"]}
+
+    def _on_leader_commit(self, ctx, st, prev_commit, is_aer):
+        pass
+
+    def _extra_message(self, ctx, st, src, tag, payload):
+        pass
+
+    def _on_become_leader(self, ctx, st, become_leader):
+        pass
 
     # -- helpers ----------------------------------------------------------
     def _last_term(self, st):
@@ -130,15 +171,17 @@ class Raft(Program):
         st["voted_for"] = jnp.where(is_el, ctx.node, st["voted_for"])
         st["votes"] = jnp.where(is_el, 1, st["votes"])
         last_t = self._last_term(st)
-        for p in range(N):
+        for p in range(self.npeers):
             ctx.send(p, RV, [st["term"], st["log_len"], last_t],
                      when=is_el & (p != ctx.node))
         self._arm_election(ctx, st, is_el)  # candidate retries on split vote
 
-        # heartbeat / replication tick (leader only)
+        # heartbeat / replication tick (leader only). AE payload layout:
+        # [term, prev_len, prev_term, leader_commit, entry_term,
+        #  *ENTRY_FIELDS, has_entry]
         is_hb = ((tag == T_HEARTBEAT) & (payload[0] == st["hgen"])
                  & (st["role"] == LEADER))
-        for p in range(N):
+        for p in range(self.npeers):
             nxt = st["next_idx"][p]
             has = nxt < st["log_len"]
             prev_term = jnp.where(nxt > 0,
@@ -147,8 +190,9 @@ class Raft(Program):
             eidx = jnp.clip(nxt, 0, L - 1)
             ctx.send(p, AE,
                      [st["term"], nxt, prev_term, st["commit"],
-                      st["log_term"][eidx], st["log_cmd"][eidx],
-                      has.astype(jnp.int32)],
+                      st["log_term"][eidx]]
+                     + [st[f"log_{f}"][eidx] for f in self.ENTRY_FIELDS]
+                     + [has.astype(jnp.int32)],
                      when=is_hb & (p != ctx.node))
         ctx.set_timer(self.hb, T_HEARTBEAT, [st["hgen"]], when=is_hb)
 
@@ -157,11 +201,12 @@ class Raft(Program):
         can = (is_pr & (st["role"] == LEADER) & (st["log_len"] < L)
                & (st["nprop"] < self.n_cmds))
         widx = jnp.clip(st["log_len"], 0, L - 1)
-        cmd = ctx.node * 65536 + st["nprop"]
+        vals = self._propose_fields(ctx, st)
         st["log_term"] = st["log_term"].at[widx].set(
             jnp.where(can, st["term"], st["log_term"][widx]))
-        st["log_cmd"] = st["log_cmd"].at[widx].set(
-            jnp.where(can, cmd, st["log_cmd"][widx]))
+        for f in self.ENTRY_FIELDS:
+            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
+                jnp.where(can, vals[f], st[f"log_{f}"][widx]))
         st["log_len"] = st["log_len"] + can
         st["nprop"] = st["nprop"] + can
         st["match_idx"] = st["match_idx"].at[ctx.node].set(
@@ -178,9 +223,12 @@ class Raft(Program):
         N, L = self.n, self.L
         majority = self.majority
         term_in = payload[0]
+        is_raft_msg = (tag == RV) | (tag == RVR) | (tag == AE) | (tag == AER)
 
-        # any message with a higher term: step down (Raft §5.1)
-        higher = term_in > st["term"]
+        # a RAFT message with a higher term: step down (Raft §5.1). Gated on
+        # tag — other protocols' payload[0] (e.g. a client call id) is NOT a
+        # term and must not depose leaders
+        higher = is_raft_msg & (term_in > st["term"])
         st["term"] = jnp.where(higher, term_in, st["term"])
         st["role"] = jnp.where(higher, FOLLOWER, st["role"])
         st["voted_for"] = jnp.where(higher, -1, st["voted_for"])
@@ -211,12 +259,16 @@ class Raft(Program):
             st["match_idx"])
         st["hgen"] = st["hgen"] + become_leader
         ctx.set_timer(0, T_HEARTBEAT, [st["hgen"]], when=become_leader)
+        self._on_become_leader(ctx, st, become_leader)
 
         # ---- AppendEntries (§5.3) ---------------------------------------
+        F = len(self.ENTRY_FIELDS)
         is_ae = tag == AE
         prev, prev_t = payload[1], payload[2]
-        lcommit, e_term, e_cmd = payload[3], payload[4], payload[5]
-        has = payload[6] == 1
+        lcommit, e_term = payload[3], payload[4]
+        e_fields = {f: payload[5 + i]
+                    for i, f in enumerate(self.ENTRY_FIELDS)}
+        has = payload[5 + F] == 1
         from_leader = is_ae & (term_in == st["term"])
         # a candidate discovering the elected leader returns to follower
         st["role"] = jnp.where(from_leader & (st["role"] == CANDIDATE),
@@ -231,8 +283,9 @@ class Raft(Program):
         write = ok & has
         st["log_term"] = st["log_term"].at[widx].set(
             jnp.where(write, e_term, st["log_term"][widx]))
-        st["log_cmd"] = st["log_cmd"].at[widx].set(
-            jnp.where(write, e_cmd, st["log_cmd"][widx]))
+        for f in self.ENTRY_FIELDS:
+            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
+                jnp.where(write, e_fields[f], st[f"log_{f}"][widx]))
         new_len = jnp.where(
             write, jnp.where(conflict, prev + 1,
                              jnp.maximum(st["log_len"], prev + 1)),
@@ -268,43 +321,56 @@ class Raft(Program):
         committable = ((cnt >= majority) & (ks < st["log_len"])
                        & (st["log_term"] == st["term"]))
         best = jnp.max(jnp.where(committable, ks + 1, 0))
+        prev_commit = st["commit"]
         st["commit"] = jnp.where(is_aer,
                                  jnp.maximum(st["commit"], best), st["commit"])
+        self._on_leader_commit(ctx, st, prev_commit, is_aer)
 
         # ---- election timer reset (vote granted or live leader heard) ---
         self._arm_election(ctx, st, grant | from_leader)
+        self._extra_message(ctx, st, src, tag, payload)
         if self.halt_on_commit:
             ctx.halt_if(st["commit"] >= self.halt_on_commit)
         ctx.state = st
 
 
-def raft_invariant(n_nodes: int, log_capacity: int = 32):
+def raft_invariant(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
+                   raft_nodes=None):
     """Global safety checks, evaluated after every event.
 
     Election Safety: at most one leader per term — the task.rs analog would
     be MadRaft's test asserting one leader (this is the §5.2 property).
     State Machine Safety: committed prefixes agree pairwise (§5.4.3).
+
+    raft_nodes: optional bool mask [N] restricting the checks to the raft
+    peers in mixed clusters (client nodes share the schema but not the
+    protocol).
     """
     N, L = n_nodes, log_capacity
     eye = jnp.eye(N, dtype=bool)
+    peer = (jnp.ones((N,), bool) if raft_nodes is None
+            else jnp.asarray(raft_nodes, bool))
 
     def invariant(state):
         ns = state.node_state
         role, term = ns["role"], ns["term"]
-        leader = role == LEADER
+        leader = (role == LEADER) & peer
         same_term = term[:, None] == term[None, :]
         two_leaders = (leader[:, None] & leader[None, :] & same_term
                        & ~eye).any()
 
-        commit = ns["commit"]
+        commit = jnp.where(peer, ns["commit"], 0)
         both_committed = jnp.minimum(commit[:, None], commit[None, :])  # [N,N]
         ks = jnp.arange(L, dtype=jnp.int32)
         in_prefix = ks[None, None, :] < both_committed[:, :, None]  # [N,N,L]
-        cmd_neq = ns["log_cmd"][:, None, :] != ns["log_cmd"][None, :, :]
         term_neq = ns["log_term"][:, None, :] != ns["log_term"][None, :, :]
-        mismatch = (in_prefix & (cmd_neq | term_neq)).any()
+        neq = term_neq
+        for f in fields:
+            col = ns[f"log_{f}"]
+            neq = neq | (col[:, None, :] != col[None, :, :])
+        mismatch = (in_prefix & neq).any()
 
-        commit_gt = (commit > ns["log_len"]).any()
+        commit_gt = (commit > jnp.where(peer, ns["log_len"], 0)).any()
 
         bad = two_leaders | mismatch | commit_gt
         code = jnp.where(

@@ -1,0 +1,281 @@
+"""Replicated KV register store on Raft, with client-observed histories —
+the full MadRaft workload (BASELINE.md config 4: log replication +
+linearizability fuzz).
+
+Cluster layout: nodes [0, R) run RaftKv (the consensus core of
+models/raft.py with a richer log entry: op/key/val/client/rtag); nodes
+[R, N) run KvClient, issuing sequential PUT/GET calls with retry-and-rotate
+on timeout. Clients record an invocation/response history into fixed-size
+state arrays; the host extracts it after the run and feeds it to the
+linearizability checker (madsim_tpu/native.py — C++ with Python fallback).
+
+Exactly-once: entries carry (client, rtag); a leader deduplicates retries
+against its own authoritative log, and replies immediately for already-
+committed duplicates. GETs are linearized through the log like writes
+(no lease/read-index shortcut), so every response is a committed operation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.api import Ctx, Program
+from ..core.types import ms
+from . import raft as R
+
+OP_PUT, OP_GET = 1, 2
+# message tags (beyond RV/RVR/AE/AER = 1..4)
+CMD, CRSP = 5, 6
+# client timer tags
+T_NEW, T_RETRY = 4, 5
+
+KV_FIELDS = ("op", "key", "val", "client", "rtag")
+
+
+def kv_state_spec(n_nodes: int, log_capacity: int, n_ops: int):
+    z = jnp.asarray(0, jnp.int32)
+    extra = dict(
+        last_replied=z,
+        # client-side bookkeeping
+        c_target=z, c_id=z, c_op=z, c_key=z, c_val=z, c_opn=z,
+        c_wait=z,
+        h_op=jnp.zeros((n_ops,), jnp.int32),
+        h_key=jnp.zeros((n_ops,), jnp.int32),
+        h_val=jnp.zeros((n_ops,), jnp.int32),
+        h_inv=jnp.full((n_ops,), -1, jnp.int32),
+        h_resp=jnp.full((n_ops,), -1, jnp.int32),
+    )
+    return R.state_spec(n_nodes, log_capacity, KV_FIELDS, extra)
+
+
+def kv_persist_spec():
+    extra = dict(last_replied=None, c_target=None, c_id=None, c_op=None,
+                 c_key=None, c_val=None, c_opn=None, c_wait=None, h_op=None,
+                 h_key=None, h_val=None, h_inv=None, h_resp=None)
+    return R.persist_spec(KV_FIELDS, extra)
+
+
+class RaftKv(R.Raft):
+    """Raft peer serving PUT/GET commands from clients."""
+
+    ENTRY_FIELDS = KV_FIELDS
+
+    def __init__(self, n_nodes: int, log_capacity: int = 64,
+                 replies_per_event: int = 2, **kw):
+        super().__init__(n_nodes, log_capacity, n_cmds=0, **kw)
+        self.replies_per_event = replies_per_event
+
+    def _propose_fields(self, ctx, st):
+        # RaftKv never self-proposes (n_cmds=0); entries come from clients
+        z = jnp.asarray(0, jnp.int32)
+        return {f: z for f in KV_FIELDS}
+
+    # -- read the register value an entry observes ------------------------
+    def _result_at(self, st, k):
+        """Result for log entry k: a PUT echoes its value; a GET reads the
+        last committed PUT to its key strictly before k (initial value 0)."""
+        L = self.L
+        kc = jnp.clip(k, 0, L - 1)
+        ks = jnp.arange(L, dtype=jnp.int32)
+        key_k = st["log_key"][kc]
+        isput = ((st["log_op"] == OP_PUT) & (st["log_key"] == key_k)
+                 & (ks < k))
+        lastput = jnp.max(jnp.where(isput, ks + 1, 0))
+        read = jnp.where(lastput > 0,
+                         st["log_val"][jnp.clip(lastput - 1, 0, L - 1)], 0)
+        return jnp.where(st["log_op"][kc] == OP_GET, read, st["log_val"][kc])
+
+    # -- hooks into the consensus core ------------------------------------
+    def _extra_message(self, ctx: Ctx, st, src, tag, payload):
+        L = self.L
+        is_cmd = tag == CMD
+        rtag, op, key, val = payload[0], payload[1], payload[2], payload[3]
+        leader = st["role"] == R.LEADER
+
+        # dedup retries against the authoritative log (exactly-once)
+        ks = jnp.arange(L, dtype=jnp.int32)
+        dup = ((st["log_rtag"] == rtag) & (st["log_client"] == src)
+               & (ks < st["log_len"]))
+        dup_any = dup.any()
+        dup_idx = jnp.argmax(dup).astype(jnp.int32)
+
+        app = is_cmd & leader & ~dup_any & (st["log_len"] < L)
+        widx = jnp.clip(st["log_len"], 0, L - 1)
+        new_vals = dict(op=op, key=key, val=val, client=src, rtag=rtag)
+        st["log_term"] = st["log_term"].at[widx].set(
+            jnp.where(app, st["term"], st["log_term"][widx]))
+        for f in KV_FIELDS:
+            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
+                jnp.where(app, new_vals[f], st[f"log_{f}"][widx]))
+        st["log_len"] = st["log_len"] + app
+        st["match_idx"] = st["match_idx"].at[ctx.node].set(
+            jnp.where(app, st["log_len"], st["match_idx"][ctx.node]))
+
+        # a duplicate that already committed answers immediately
+        dup_done = is_cmd & leader & dup_any & (dup_idx < st["commit"])
+        ctx.send(src, CRSP, [rtag, self._result_at(st, dup_idx)],
+                 when=dup_done)
+        # non-leaders drop client commands; the client's retry timer rotates
+        # it to another node (no redirect hints — pure fuzzing pressure)
+
+    def _on_leader_commit(self, ctx: Ctx, st, prev_commit, is_aer):
+        base = st["last_replied"]
+        for j in range(self.replies_per_event):
+            k = base + j
+            kc = jnp.clip(k, 0, self.L - 1)
+            m = (is_aer & (st["role"] == R.LEADER) & (k < st["commit"])
+                 & (st["log_op"][kc] != 0))  # no-op entries have no caller
+            ctx.send(st["log_client"][kc], CRSP,
+                     [st["log_rtag"][kc], self._result_at(st, k)], when=m)
+        st["last_replied"] = jnp.where(
+            is_aer, jnp.minimum(st["commit"],
+                                base + self.replies_per_event), base)
+
+    def _on_become_leader(self, ctx: Ctx, st, become_leader):
+        # entries committed under predecessors were already answered (or
+        # will be re-asked and hit the dedup fast path)
+        st["last_replied"] = jnp.where(become_leader, st["commit"],
+                                       st["last_replied"])
+        # append a no-op entry (op=0): a leader can only count commits for
+        # current-term entries (§5.4.2), and clients' retries dedup against
+        # inherited entries instead of re-appending — without a fresh entry
+        # the new leader could never advance commit (livelock)
+        app = become_leader & (st["log_len"] < self.L)
+        widx = jnp.clip(st["log_len"], 0, self.L - 1)
+        st["log_term"] = st["log_term"].at[widx].set(
+            jnp.where(app, st["term"], st["log_term"][widx]))
+        for f in KV_FIELDS:
+            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
+                jnp.where(app, 0, st[f"log_{f}"][widx]))
+        st["log_len"] = st["log_len"] + app
+        st["match_idx"] = st["match_idx"].at[ctx.node].set(
+            jnp.where(app, st["log_len"], st["match_idx"][ctx.node]))
+
+
+class KvClient(Program):
+    """Sequential closed-loop client: one outstanding op, retry with target
+    rotation on timeout, per-op invocation/response history recording."""
+
+    def __init__(self, n_raft: int, n_keys: int = 4, n_ops: int = 12,
+                 timeout=ms(60), think=ms(10)):
+        self.R = n_raft
+        self.K = n_keys
+        self.O = n_ops
+        self.timeout = timeout
+        self.think = think
+
+    def init(self, ctx: Ctx):
+        st = dict(ctx.state)
+        st["c_target"] = ctx.randint(0, self.R - 1)
+        ctx.set_timer(ctx.randint(0, ms(20)), T_NEW, [0])
+        ctx.state = st
+
+    def _issue(self, ctx, st, when):
+        ctx.send(st["c_target"], CMD,
+                 [st["c_id"], st["c_op"], st["c_key"], st["c_val"]],
+                 when=when)
+        ctx.set_timer(self.timeout, T_RETRY, [st["c_id"]], when=when)
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        start = ((tag == T_NEW) & (st["c_wait"] == 0)
+                 & (st["c_opn"] < self.O))
+        st["c_id"] = jnp.where(start, ctx.randint(1, 2**30 - 1), st["c_id"])
+        st["c_op"] = jnp.where(start,
+                               jnp.where(ctx.bernoulli(0.5), OP_PUT, OP_GET),
+                               st["c_op"])
+        st["c_key"] = jnp.where(start, ctx.randint(0, self.K - 1),
+                                st["c_key"])
+        st["c_val"] = jnp.where(start, ctx.node * 4096 + st["c_opn"],
+                                st["c_val"])
+        st["c_wait"] = jnp.where(start, 1, st["c_wait"])
+        oidx = jnp.clip(st["c_opn"], 0, self.O - 1)
+        st["h_op"] = st["h_op"].at[oidx].set(
+            jnp.where(start, st["c_op"], st["h_op"][oidx]))
+        st["h_key"] = st["h_key"].at[oidx].set(
+            jnp.where(start, st["c_key"], st["h_key"][oidx]))
+        st["h_val"] = st["h_val"].at[oidx].set(
+            jnp.where(start, st["c_val"], st["h_val"][oidx]))
+        st["h_inv"] = st["h_inv"].at[oidx].set(
+            jnp.where(start, ctx.now, st["h_inv"][oidx]))
+
+        # timeout: rotate to a random raft node and retry the SAME call id
+        retry = ((tag == T_RETRY) & (st["c_wait"] == 1)
+                 & (payload[0] == st["c_id"]))
+        st["c_target"] = jnp.where(retry, ctx.randint(0, self.R - 1),
+                                   st["c_target"])
+        self._issue(ctx, st, start | retry)
+        ctx.state = st
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        hit = ((tag == CRSP) & (st["c_wait"] == 1)
+               & (payload[0] == st["c_id"]))
+        oidx = jnp.clip(st["c_opn"], 0, self.O - 1)
+        st["h_resp"] = st["h_resp"].at[oidx].set(
+            jnp.where(hit, ctx.now, st["h_resp"][oidx]))
+        st["h_val"] = st["h_val"].at[oidx].set(
+            jnp.where(hit & (st["h_op"][oidx] == OP_GET), payload[1],
+                      st["h_val"][oidx]))
+        st["c_opn"] = st["c_opn"] + hit
+        st["c_wait"] = jnp.where(hit, 0, st["c_wait"])
+        ctx.set_timer(self.think, T_NEW, [0], when=hit)
+        ctx.state = st
+
+
+def all_clients_done(n_raft: int, n_ops: int):
+    def check(state):
+        return (state.node_state["c_opn"][n_raft:] >= n_ops).all()
+    return check
+
+
+def make_kv_runtime(n_raft=5, n_clients=3, n_keys=4, n_ops=12,
+                    log_capacity=64, scenario=None, cfg=None, **raft_kw):
+    from ..core.types import NetConfig, SimConfig, sec
+    from ..runtime.runtime import Runtime
+    n = n_raft + n_clients
+    if cfg is None:
+        cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=12,
+                        time_limit=sec(20))
+    assert cfg.payload_words >= 6 + len(KV_FIELDS)
+    assert log_capacity >= n_clients * n_ops, \
+        "log must fit every client op (plus dedup slack is advisable)"
+    raft_kw.setdefault("n_peers", n_raft)  # quorum over servers, not clients
+    prog_raft = RaftKv(n, log_capacity, **raft_kw)
+    prog_client = KvClient(n_raft, n_keys, n_ops)
+    node_prog = np.asarray([0] * n_raft + [1] * n_clients, np.int32)
+    peer_mask = np.asarray([True] * n_raft + [False] * n_clients)
+    rt = Runtime(cfg, [prog_raft, prog_client],
+                 kv_state_spec(n, log_capacity, n_ops),
+                 node_prog=node_prog, scenario=scenario,
+                 invariant=R.raft_invariant(n, log_capacity, KV_FIELDS,
+                                            peer_mask),
+                 persist=kv_persist_spec(),
+                 halt_when=all_clients_done(n_raft, n_ops))
+    return rt
+
+
+def extract_histories(state, n_raft: int, n_clients: int):
+    """Pull per-trajectory client histories out of the final batched state.
+
+    Returns a list (one per trajectory) of dicts with numpy arrays
+    op/key/val/inv/resp flattened over clients (resp == -1 for ops still
+    outstanding at halt — the checker treats those as possibly-applied).
+    """
+    ns = state.node_state
+    out = []
+    h = {k: np.asarray(ns[k]) for k in
+         ("h_op", "h_key", "h_val", "h_inv", "h_resp")}
+    B = h["h_op"].shape[0]
+    for b in range(B):
+        sl = slice(n_raft, n_raft + n_clients)
+        started = h["h_inv"][b, sl] >= 0
+        out.append(dict(
+            op=h["h_op"][b, sl][started],
+            key=h["h_key"][b, sl][started],
+            val=h["h_val"][b, sl][started],
+            inv=h["h_inv"][b, sl][started],
+            resp=h["h_resp"][b, sl][started],
+        ))
+    return out

@@ -1,0 +1,124 @@
+"""Native host-side components: build + ctypes bindings, with pure-Python
+fallbacks.
+
+The TPU runs the vectorized simulation; history *checking* is sequential
+search on the host, so it is native C++ (native/linearize.cpp), compiled on
+first use with g++ into a cached shared object and bound via ctypes (no
+pybind11 in this environment). Every native entry point has a pure-Python
+fallback used when no compiler is available — and for differential testing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "native", "linearize.cpp")
+_SO = os.path.join(_ROOT, "native", "liblinearize.so")
+
+_lib = None
+_lib_tried = False
+
+
+def _load():
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO)
+        lib.lin_check_register.restype = ctypes.c_int
+        lib.lin_check_register.argtypes = [
+            ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+    except Exception as e:  # no compiler / load failure -> fallback
+        print(f"madsim_tpu.native: falling back to python checker ({e})",
+              file=sys.stderr)
+        _lib = None
+    return _lib
+
+
+def _check_register_py(op, val, inv, resp) -> bool:
+    """Pure-Python mirror of native/linearize.cpp (same algorithm)."""
+    n = len(op)
+    if n == 0:
+        return True
+    seen = set()
+
+    def dfs(mask, value):
+        if mask == 0:
+            return True
+        key = (mask, value)
+        if key in seen:
+            return False
+        seen.add(key)
+        minresp = min((resp[i] for i in range(n)
+                       if (mask >> i) & 1 and resp[i] >= 0),
+                      default=None)
+        for i in range(n):
+            if not (mask >> i) & 1:
+                continue
+            if minresp is not None and inv[i] > minresp:
+                continue
+            rest = mask & ~(1 << i)
+            if op[i] == 1:
+                if dfs(rest, val[i]):
+                    return True
+            else:
+                if val[i] == value and dfs(rest, value):
+                    return True
+            if resp[i] < 0 and dfs(rest, value):
+                return True
+        return False
+
+    return dfs((1 << n) - 1, 0)
+
+
+def check_register(op, val, inv, resp, force_python=False) -> bool:
+    """Is this single-register history linearizable (initial value 0)?
+
+    op: 1=PUT, 2=GET; val: written/observed value; inv/resp: times,
+    resp < 0 marks a pending op (may or may not have taken effect).
+    """
+    op = np.ascontiguousarray(op, np.int32)
+    val = np.ascontiguousarray(val, np.int32)
+    inv = np.ascontiguousarray(inv, np.int64)
+    resp = np.ascontiguousarray(resp, np.int64)
+    lib = None if force_python else _load()
+    if lib is not None and len(op) <= 57:
+        r = lib.lin_check_register(len(op), op, val, inv, resp)
+        if r >= 0:
+            return bool(r)
+    return _check_register_py(op.tolist(), val.tolist(), inv.tolist(),
+                              resp.tolist())
+
+
+def check_kv_history(hist: dict, force_python=False) -> bool:
+    """Linearizability of a multi-key KV history: registers compose, so
+    each key's sub-history is checked independently (P-compositionality).
+
+    hist: dict of numpy arrays op/key/val/inv/resp (see
+    models/raft_kv.extract_histories).
+    """
+    keys = np.unique(hist["key"])
+    for k in keys:
+        m = hist["key"] == k
+        if not check_register(hist["op"][m], hist["val"][m], hist["inv"][m],
+                              hist["resp"][m], force_python=force_python):
+            return False
+    return True

@@ -99,19 +99,35 @@ class _At:
     def resume(self, node):
         return self._add(T.OP_RESUME, node)
 
-    def kill_random(self):
-        """Kill a random alive node — target drawn per-seed at fire time."""
-        return self._add(T.OP_KILL, T.NODE_RANDOM)
+    @staticmethod
+    def _pool(among):
+        """Candidate bitmask for random targets (None = everyone)."""
+        if among is None:
+            return ()
+        among = list(among)
+        assert among, "among=[] would mean 'no restriction'; pass None for that"
+        mask = 0
+        for n in among:
+            assert 0 <= int(n) < 31, "pool restriction supports nodes 0..30"
+            mask |= 1 << int(n)
+        return (mask,)
 
-    def restart_random(self):
+    def kill_random(self, among=None):
+        """Kill a random alive node — target drawn per-seed at fire time.
+        `among` restricts candidates (e.g. servers only, not clients)."""
+        return self._add(T.OP_KILL, T.NODE_RANDOM, payload=self._pool(among))
+
+    def restart_random(self, among=None):
         """Restart a random dead node."""
-        return self._add(T.OP_RESTART, T.NODE_RANDOM)
+        return self._add(T.OP_RESTART, T.NODE_RANDOM,
+                         payload=self._pool(among))
 
-    def pause_random(self):
-        return self._add(T.OP_PAUSE, T.NODE_RANDOM)
+    def pause_random(self, among=None):
+        return self._add(T.OP_PAUSE, T.NODE_RANDOM, payload=self._pool(among))
 
-    def resume_random(self):
-        return self._add(T.OP_RESUME, T.NODE_RANDOM)
+    def resume_random(self, among=None):
+        return self._add(T.OP_RESUME, T.NODE_RANDOM,
+                         payload=self._pool(among))
 
     # -- network faults (NetSim) ------------------------------------------
     def clog_node(self, node):

@@ -1,0 +1,118 @@
+"""KV-on-Raft linearizability fuzz — BASELINE.md config 4 — plus unit tests
+for the checker itself (C++ and Python implementations, differentially)."""
+
+import numpy as np
+import pytest
+
+from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
+from madsim_tpu.harness.simtest import run_seeds
+from madsim_tpu.models.raft_kv import extract_histories, make_kv_runtime
+from madsim_tpu.native import check_kv_history, check_register
+
+PUT, GET = 1, 2
+
+
+def H(*ops):
+    """ops: (op, val, inv, resp) tuples -> checker args."""
+    a = np.asarray(ops, np.int64).reshape(-1, 4)
+    return a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+
+
+class TestCheckerUnit:
+    CASES = [
+        # (ops, expected)
+        ([(GET, 0, 0, 1)], True),                       # read initial value
+        ([(GET, 5, 0, 1)], False),                      # read from nowhere
+        ([(PUT, 5, 0, 1), (GET, 5, 2, 3)], True),
+        ([(PUT, 5, 0, 1), (GET, 0, 2, 3)], False),      # stale read
+        # concurrent put/get: either order is fine
+        ([(PUT, 5, 0, 10), (GET, 5, 1, 2)], True),
+        ([(PUT, 5, 0, 10), (GET, 0, 1, 2)], True),
+        # sequential reads observing value regression -> not linearizable
+        ([(PUT, 1, 0, 1), (PUT, 2, 2, 3), (GET, 2, 4, 5), (GET, 1, 6, 7)],
+         False),
+        # pending put may or may not apply: both observations OK
+        ([(PUT, 9, 0, -1), (GET, 9, 5, 6)], True),
+        ([(PUT, 9, 0, -1), (GET, 0, 5, 6)], True),
+        # but a pending put cannot apply before its invocation
+        ([(GET, 9, 0, 1), (PUT, 9, 5, -1)], False),
+        # two concurrent puts, reads pin the final order
+        ([(PUT, 1, 0, 10), (PUT, 2, 0, 10), (GET, 1, 11, 12),
+          (GET, 2, 13, 14)], False),  # 2 then 1 impossible after seeing 1
+    ]
+
+    @pytest.mark.parametrize("ops,expected", CASES)
+    def test_cpp_and_python_agree(self, ops, expected):
+        op, val, inv, resp = H(*ops)
+        assert check_register(op, val, inv, resp) is expected
+        assert check_register(op, val, inv, resp,
+                              force_python=True) is expected
+
+    def test_native_library_builds(self):
+        from madsim_tpu import native
+        assert native._load() is not None, "g++ build of the checker failed"
+
+
+def _chaos_scenario(n_raft):
+    servers = range(n_raft)  # kill servers, never the client harness nodes
+    sc = Scenario()
+    for t in range(4):
+        sc.at(ms(900 + 900 * t)).kill_random(among=servers)
+        sc.at(ms(1400 + 900 * t)).restart_random(among=servers)
+    sc.at(sec(2)).partition([0, 1])
+    sc.at(sec(3)).heal()
+    return sc
+
+
+class TestKvFuzz:
+    def test_clean_network_all_linearizable(self):
+        rt = make_kv_runtime(n_raft=3, n_clients=2, n_keys=2, n_ops=6,
+                             log_capacity=32)
+        state = run_seeds(rt, np.arange(8), max_steps=30_000)
+        hists = extract_histories(state, 3, 2)
+        assert all(len(h["op"]) > 0 for h in hists)
+        for h in hists:
+            assert check_kv_history(h)
+
+    def test_chaos_histories_linearizable(self):
+        # kills/partitions/loss: ops may time out (pending), leaders churn,
+        # but every observed response must stay linearizable
+        cfg = SimConfig(n_nodes=8, event_capacity=384, payload_words=12,
+                        time_limit=sec(8),
+                        net=NetConfig(packet_loss_rate=0.05))
+        rt = make_kv_runtime(n_raft=5, n_clients=3, n_keys=3, n_ops=8,
+                             log_capacity=48,
+                             scenario=_chaos_scenario(5), cfg=cfg)
+        state = run_seeds(rt, np.arange(8), max_steps=60_000)
+        hists = extract_histories(state, 5, 3)
+        completed = sum(int((h["resp"] >= 0).sum()) for h in hists)
+        assert completed > 0
+        for h in hists:
+            assert check_kv_history(h)
+
+    def test_detector_catches_corruption(self):
+        # mutate one observed GET: the checker must reject the history
+        rt = make_kv_runtime(n_raft=3, n_clients=2, n_keys=1, n_ops=6,
+                             log_capacity=32)
+        state = run_seeds(rt, np.arange(4), max_steps=30_000)
+        hists = extract_histories(state, 3, 2)
+        h = hists[0]
+        gets = np.nonzero((h["op"] == GET) & (h["resp"] >= 0))[0]
+        puts = np.nonzero(h["op"] == PUT)[0]
+        if len(gets) == 0 or len(puts) == 0:
+            pytest.skip("history lacks a completed GET/PUT pair")
+        h["val"][gets[0]] = 999_999  # a value nobody ever wrote
+        assert not check_kv_history(h)
+
+    def test_minority_server_failure_tolerated(self):
+        # one server dead forever: quorum must be over the 5 raft peers
+        # (3 of 5), not peers+clients, so every client op still completes
+        sc = Scenario()
+        sc.at(ms(50)).kill(1)
+        rt = make_kv_runtime(n_raft=5, n_clients=2, n_keys=2, n_ops=6,
+                             log_capacity=32, scenario=sc)
+        state = run_seeds(rt, np.arange(8), max_steps=60_000)
+        opn = np.asarray(state.node_state["c_opn"])[:, 5:]
+        assert (opn >= 6).all()
+        for h in extract_histories(state, 5, 2):
+            assert check_kv_history(h)
