@@ -1,0 +1,49 @@
+"""Nondeterminism detection and localization.
+
+The reference's rand-log checker hashes every RNG draw and panics at the
+first divergent draw on replay, localizing nondeterminism in virtual time
+(rand.rs:72-96, runtime/mod.rs:144-187, MADSIM_TEST_CHECK_DETERMINISM).
+Because our whole cluster is a tensor state, the analog is stronger and
+simpler: run two replicas of the same seed in lockstep, fingerprint the
+full state, and bisect to the first divergent STEP — then show the event
+that was dispatched there.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..utils.hashing import fingerprint
+
+
+def find_divergence(rt, seed: int, max_steps: int, probe: int = 64):
+    """Run seed twice in lockstep; return None if identical, else a dict
+    {step, event} locating the first step whose post-state fingerprints
+    differ (the take-rand-log/check panic analog, with the event attached).
+    """
+    vfp = jax.jit(jax.vmap(fingerprint))
+    runner = rt._run_chunk[True]
+
+    s1 = rt.init_single(seed)
+    s2 = rt.init_single(seed)
+    step = 0
+    while step < max_steps:
+        n1, e1 = runner(s1, probe)
+        n2, e2 = runner(s2, probe)
+        if np.asarray(vfp(n1))[0] != np.asarray(vfp(n2))[0]:
+            # bisect inside this probe window, one step at a time (probe is
+            # small; recompiling a length-1 chunk once is fine)
+            one = rt._run_chunk[True]
+            for j in range(probe):
+                s1, e1 = one(s1, 1)
+                s2, e2 = one(s2, 1)
+                if np.asarray(vfp(s1))[0] != np.asarray(vfp(s2))[0]:
+                    ev = {k: np.asarray(v)[0, 0] for k, v in e1.items()}
+                    return dict(step=step + j, event=ev)
+            return dict(step=step + probe - 1, event=None)
+        s1, s2 = n1, n2
+        step += probe
+        if bool(np.asarray(n1.halted).all()):
+            break
+    return None

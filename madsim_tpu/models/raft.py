@@ -140,6 +140,21 @@ class Raft(Program):
     def _on_become_leader(self, ctx, st, become_leader):
         pass
 
+    def _append(self, ctx, st, when, vals):
+        """Leader-side masked append of one entry (term = current term).
+        Shared by the propose tick, client commands, and election no-ops."""
+        when = when & (st["log_len"] < self.L)
+        widx = jnp.clip(st["log_len"], 0, self.L - 1)
+        st["log_term"] = st["log_term"].at[widx].set(
+            jnp.where(when, st["term"], st["log_term"][widx]))
+        for f in self.ENTRY_FIELDS:
+            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
+                jnp.where(when, vals[f], st[f"log_{f}"][widx]))
+        st["log_len"] = st["log_len"] + when
+        st["match_idx"] = st["match_idx"].at[ctx.node].set(
+            jnp.where(when, st["log_len"], st["match_idx"][ctx.node]))
+        return when
+
     # -- helpers ----------------------------------------------------------
     def _last_term(self, st):
         return jnp.where(st["log_len"] > 0,
@@ -198,19 +213,10 @@ class Raft(Program):
 
         # self-proposing client: leaders append a fresh command
         is_pr = tag == T_PROPOSE
-        can = (is_pr & (st["role"] == LEADER) & (st["log_len"] < L)
+        can = (is_pr & (st["role"] == LEADER)
                & (st["nprop"] < self.n_cmds))
-        widx = jnp.clip(st["log_len"], 0, L - 1)
-        vals = self._propose_fields(ctx, st)
-        st["log_term"] = st["log_term"].at[widx].set(
-            jnp.where(can, st["term"], st["log_term"][widx]))
-        for f in self.ENTRY_FIELDS:
-            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
-                jnp.where(can, vals[f], st[f"log_{f}"][widx]))
-        st["log_len"] = st["log_len"] + can
-        st["nprop"] = st["nprop"] + can
-        st["match_idx"] = st["match_idx"].at[ctx.node].set(
-            jnp.where(can, st["log_len"], st["match_idx"][ctx.node]))
+        appended = self._append(ctx, st, can, self._propose_fields(ctx, st))
+        st["nprop"] = st["nprop"] + appended
         ctx.set_timer(self.prop, T_PROPOSE, [0], when=is_pr)
 
         if self.halt_on_commit:

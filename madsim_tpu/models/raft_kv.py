@@ -100,17 +100,8 @@ class RaftKv(R.Raft):
         dup_any = dup.any()
         dup_idx = jnp.argmax(dup).astype(jnp.int32)
 
-        app = is_cmd & leader & ~dup_any & (st["log_len"] < L)
-        widx = jnp.clip(st["log_len"], 0, L - 1)
-        new_vals = dict(op=op, key=key, val=val, client=src, rtag=rtag)
-        st["log_term"] = st["log_term"].at[widx].set(
-            jnp.where(app, st["term"], st["log_term"][widx]))
-        for f in KV_FIELDS:
-            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
-                jnp.where(app, new_vals[f], st[f"log_{f}"][widx]))
-        st["log_len"] = st["log_len"] + app
-        st["match_idx"] = st["match_idx"].at[ctx.node].set(
-            jnp.where(app, st["log_len"], st["match_idx"][ctx.node]))
+        self._append(ctx, st, is_cmd & leader & ~dup_any,
+                     dict(op=op, key=key, val=val, client=src, rtag=rtag))
 
         # a duplicate that already committed answers immediately
         dup_done = is_cmd & leader & dup_any & (dup_idx < st["commit"])
@@ -140,17 +131,13 @@ class RaftKv(R.Raft):
         # append a no-op entry (op=0): a leader can only count commits for
         # current-term entries (§5.4.2), and clients' retries dedup against
         # inherited entries instead of re-appending — without a fresh entry
-        # the new leader could never advance commit (livelock)
-        app = become_leader & (st["log_len"] < self.L)
-        widx = jnp.clip(st["log_len"], 0, self.L - 1)
-        st["log_term"] = st["log_term"].at[widx].set(
-            jnp.where(app, st["term"], st["log_term"][widx]))
-        for f in KV_FIELDS:
-            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
-                jnp.where(app, 0, st[f"log_{f}"][widx]))
-        st["log_len"] = st["log_len"] + app
-        st["match_idx"] = st["match_idx"].at[ctx.node].set(
-            jnp.where(app, st["log_len"], st["match_idx"][ctx.node]))
+        # the new leader could never advance commit (livelock). Only needed
+        # when uncommitted inherited entries exist; gating on that keeps
+        # leader churn from eating the log capacity.
+        z = jnp.asarray(0, jnp.int32)
+        self._append(ctx, st,
+                     become_leader & (st["commit"] < st["log_len"]),
+                     {f: z for f in KV_FIELDS})
 
 
 class KvClient(Program):
@@ -239,8 +226,9 @@ def make_kv_runtime(n_raft=5, n_clients=3, n_keys=4, n_ops=12,
         cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=12,
                         time_limit=sec(20))
     assert cfg.payload_words >= 6 + len(KV_FIELDS)
-    assert log_capacity >= n_clients * n_ops, \
-        "log must fit every client op (plus dedup slack is advisable)"
+    assert log_capacity >= n_clients * n_ops + 4, \
+        ("log must fit every client op plus slack for election no-ops "
+         "(one per leader change with uncommitted inherited entries)")
     raft_kw.setdefault("n_peers", n_raft)  # quorum over servers, not clients
     prog_raft = RaftKv(n, log_capacity, **raft_kw)
     prog_client = KvClient(n_raft, n_keys, n_ops)

@@ -1,0 +1,203 @@
+"""Real-world runtime: the production twin of the simulator.
+
+madsim's signature property is compile-time world-switching — the same
+application source runs inside the simulator or against real tokio/TCP with
+zero changes (madsim/src/lib.rs:15-24 selects `mod sim` vs `mod std`;
+std/net/tcp.rs is the real Endpoint). The analog here: the SAME `Program`
+subclasses (state machines over jnp ops, which execute eagerly on concrete
+arrays) run either vectorized under jit (runtime/runtime.py) or against real
+wall-clock time and real UDP sockets via this asyncio runtime. Protocol code
+is written once; the world is chosen at Runtime-construction time.
+
+Wire format: little-endian int32s [tag, src_node, payload[P]] — the
+tag-matched datagram model of the reference's real TCP backend
+(std/net/tcp.rs frames [len][tag][payload]), minus streams (UDP fits the
+sim's message semantics; loss/reorder are real-network properties here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import prng
+from ..core import types as T
+from ..core.api import Ctx, Program
+
+
+class _NodeProtocol(asyncio.DatagramProtocol):
+    def __init__(self, rt: "RealRuntime", node: int):
+        self.rt, self.node = rt, node
+
+    def datagram_received(self, data, addr):
+        self.rt._on_datagram(self.node, data)
+
+
+class RealNode:
+    def __init__(self, node_id: int, state):
+        self.id = node_id
+        self.state = state
+        self.alive = False
+        self.paused = False
+        self.parked: list = []         # events deferred while paused
+        self.transport = None
+        self.timers: list[asyncio.TimerHandle] = []
+
+
+class RealRuntime:
+    """Run programs against real time + UDP on 127.0.0.1.
+
+    API mirrors the simulator Runtime's supervisor surface
+    (kill/restart/pause/resume — runtime/mod.rs:200-256) but every operation
+    is a real effect: sockets close, wall-clock timers cancel.
+    """
+
+    def __init__(self, cfg: T.SimConfig, programs: Sequence[Program],
+                 state_spec: Any, node_prog=None, base_port: int = 19200,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.programs = list(programs)
+        self.node_prog = list(node_prog if node_prog is not None
+                              else [0] * cfg.n_nodes)
+        self.spec = state_spec
+        self.base_port = base_port
+        self.key = prng.seed_key(seed)
+        self.nodes = [RealNode(i, self._fresh_state())
+                      for i in range(cfg.n_nodes)]
+        self.t0 = time.monotonic()
+        self.crashed: list[tuple[int, int]] = []   # (node, code)
+        self._halted = asyncio.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    # ------------------------------------------------------------------
+    def _fresh_state(self):
+        return {k: jnp.asarray(v) for k, v in self.spec.items()} \
+            if isinstance(self.spec, dict) else \
+            __import__("jax").tree.map(lambda a: jnp.asarray(a), self.spec)
+
+    def now(self) -> int:
+        """Virtual-time API, real clock: ticks (us) since runtime start."""
+        return int((time.monotonic() - self.t0) * T.TICKS_PER_SEC)
+
+    def _next_key(self):
+        self.key, k = prng.split(self.key)
+        return k
+
+    # -- lifecycle (Handle analog) -------------------------------------
+    async def start_node(self, i: int):
+        n = self.nodes[i]
+        loop = asyncio.get_running_loop()
+        n.transport, _ = await loop.create_datagram_endpoint(
+            lambda: _NodeProtocol(self, i),
+            local_addr=("127.0.0.1", self.base_port + i))
+        n.alive = True
+        self._dispatch(i, "init")
+
+    def kill(self, i: int):
+        n = self.nodes[i]
+        n.alive = False
+        n.paused = False
+        n.parked.clear()
+        for t in n.timers:
+            t.cancel()
+        n.timers.clear()
+        if n.transport:
+            n.transport.close()
+            n.transport = None
+
+    async def restart(self, i: int):
+        self.kill(i)
+        self.nodes[i].state = self._fresh_state()  # process memory is lost
+        await self.start_node(i)
+
+    def pause(self, i: int):
+        self.nodes[i].paused = True
+
+    def resume(self, i: int):
+        n = self.nodes[i]
+        n.paused = False
+        parked, n.parked = n.parked, []
+        for kind, args in parked:
+            self._dispatch(i, kind, *args)
+
+    # -- event plumbing -------------------------------------------------
+    def _on_datagram(self, node: int, data: bytes):
+        P = self.cfg.payload_words
+        tag, src, *payload = struct.unpack(f"<ii{P}i", data)
+        self._dispatch(node, "message", src, tag,
+                       jnp.asarray(payload, jnp.int32))
+
+    def _dispatch(self, node: int, kind: str, *args):
+        n = self.nodes[node]
+        if not n.alive:
+            return
+        if n.paused:
+            n.parked.append((kind, args))
+            return
+        prog = self.programs[self.node_prog[node]]
+        ctx = Ctx(self.cfg, jnp.asarray(node, jnp.int32),
+                  jnp.asarray(self.now(), jnp.int32), self._next_key(),
+                  n.state)
+        if kind == "init":
+            prog.init(ctx)
+        elif kind == "message":
+            prog.on_message(ctx, jnp.asarray(args[0], jnp.int32),
+                            jnp.asarray(args[1], jnp.int32), args[2])
+        else:
+            prog.on_timer(ctx, jnp.asarray(args[0], jnp.int32), args[1])
+        self._apply(n, ctx)
+
+    def _apply(self, n: RealNode, ctx: Ctx):
+        P = self.cfg.payload_words
+        n.state = ctx.state
+        for e in ctx._sends:
+            if not bool(e["m"]):
+                continue
+            dst = int(e["dst"])
+            pkt = struct.pack(f"<ii{P}i", int(e["tag"]), n.id,
+                              *np.asarray(e["payload"], np.int32))
+            if n.transport is not None and 0 <= dst < self.cfg.n_nodes:
+                # real send: straight to the peer's socket; latency, loss
+                # and reordering are whatever the real network does
+                n.transport.sendto(pkt, ("127.0.0.1", self.base_port + dst))
+        for e in ctx._timers:
+            if not bool(e["m"]):
+                continue
+            delay = int(e["delay"]) / T.TICKS_PER_SEC
+            tag = jnp.asarray(int(e["tag"]), jnp.int32)
+            payload = e["payload"]
+            h = self._loop.call_later(
+                delay, self._dispatch, n.id, "timer", tag, payload)
+            n.timers.append(h)
+        if bool(ctx._crash):
+            self.crashed.append((n.id, int(ctx._crash_code)))
+            self._halted.set()
+        if bool(ctx._halt):
+            self._halted.set()
+
+    # -- entry point ----------------------------------------------------
+    async def _main(self, duration: float):
+        self._loop = asyncio.get_running_loop()
+        self.t0 = time.monotonic()
+        for i in range(self.cfg.n_nodes):
+            await self.start_node(i)
+        try:
+            await asyncio.wait_for(self._halted.wait(), timeout=duration)
+        except asyncio.TimeoutError:
+            pass
+        for i in range(self.cfg.n_nodes):
+            self.kill(i)
+
+    def run(self, duration: float = 2.0):
+        """Block until a program halts/crashes or `duration` seconds pass.
+        The `#[madsim::main]` real-mode analog (macros lib.rs:46-78)."""
+        asyncio.run(self._main(duration))
+        return self
+
+    def states(self):
+        return [n.state for n in self.nodes]

@@ -1,0 +1,47 @@
+"""Checkpoint / resume: snapshot whole seed batches.
+
+The reference has NO checkpointing — reproducibility is replay-by-seed only
+(SURVEY.md §5). Here the entire cluster state of every trajectory is one
+pytree of device arrays, so a checkpoint is a device-to-host copy: save a
+100k-seed fuzz mid-flight, resume it later (or elsewhere), or stash the
+exact pre-crash batch for postmortem. This is strictly beyond reference
+parity, enabled by the state-as-tensor design.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..core.state import SimState
+
+
+def save(path: str, state: SimState) -> None:
+    """Write a (batched or single) SimState to an .npz archive."""
+    leaves, treedef = jax.tree.flatten(state)
+    np.savez_compressed(
+        path, __treedef__=np.frombuffer(
+            repr(treedef).encode(), dtype=np.uint8),
+        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+
+
+def load(path: str, like: SimState) -> SimState:
+    """Read a SimState saved by `save`. `like` supplies the pytree structure
+    (build it from the same Runtime, e.g. rt.init_batch(...)); shapes and
+    dtypes are validated leaf-by-leaf."""
+    with np.load(path) as z:
+        leaves_like, treedef = jax.tree.flatten(like)
+        n = len([k for k in z.files if k.startswith("leaf_")])
+        if n != len(leaves_like):
+            raise ValueError(
+                f"checkpoint has {n} leaves, runtime expects "
+                f"{len(leaves_like)} — different config/programs?")
+        leaves = []
+        for i, ref in enumerate(leaves_like):
+            arr = z[f"leaf_{i}"]
+            if arr.shape != ref.shape or arr.dtype != np.asarray(ref).dtype:
+                raise ValueError(
+                    f"checkpoint leaf {i}: {arr.shape}/{arr.dtype} != "
+                    f"expected {ref.shape}/{np.asarray(ref).dtype}")
+            leaves.append(arr)
+        return jax.tree.unflatten(treedef, leaves)

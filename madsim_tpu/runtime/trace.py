@@ -1,0 +1,57 @@
+"""Event-trace formatting: the virtual-time logger.
+
+The reference's logger stamps every record with virtual time, node, and
+target (`[virtual-time level node target] msg`, runtime/mod.rs:342-383) and
+can filter records before a virtual instant (MADSIM_LOG_TIME_START,
+runtime/mod.rs:349-358). Here the engine emits a structured event record per
+step (when run with collect_events=True); this module renders one seed's
+stream the same way for debugging a replayed failure.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import types as T
+
+_KIND = {T.EV_MSG: "MSG", T.EV_TIMER: "TIMER", T.EV_SUPER: "SUPER"}
+_OP = {v: k[3:] for k, v in vars(T).items() if k.startswith("OP_")}
+
+
+def format_trace(events: dict, b: int = 0, time_start: int = 0,
+                 node_names=None, limit: int | None = None) -> list[str]:
+    """Render trajectory b's event stream as text lines.
+
+    events: the structure returned by Runtime.run(collect_events=True) —
+    arrays shaped [steps, batch, ...]. time_start filters records before a
+    virtual instant (the MADSIM_LOG_TIME_START analog).
+    """
+    fired = np.asarray(events["fired"])[:, b]
+    now = np.asarray(events["now"])[:, b]
+    kind = np.asarray(events["kind"])[:, b]
+    node = np.asarray(events["node"])[:, b]
+    src = np.asarray(events["src"])[:, b]
+    tag = np.asarray(events["tag"])[:, b]
+    lines = []
+    for i in np.nonzero(fired)[0]:
+        if now[i] < time_start:
+            continue
+        t_ms = now[i] / T.TICKS_PER_MS
+        name = (node_names[node[i]] if node_names is not None
+                else f"node{node[i]}")
+        k = _KIND.get(int(kind[i]), f"?{kind[i]}")
+        if kind[i] == T.EV_MSG:
+            detail = f"tag={tag[i]} from {src[i]}"
+        elif kind[i] == T.EV_SUPER:
+            detail = _OP.get(int(tag[i]), f"op={tag[i]}")
+        else:
+            detail = f"tag={tag[i]}"
+        lines.append(f"[{t_ms:12.3f}ms {name:>7} {k:>5}] {detail}")
+        if limit is not None and len(lines) >= limit:
+            break
+    return lines
+
+
+def print_trace(events: dict, b: int = 0, **kw) -> None:
+    for line in format_trace(events, b, **kw):
+        print(line)

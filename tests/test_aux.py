@@ -1,0 +1,62 @@
+"""Aux subsystems: checkpoint/resume, trace formatting, divergence finder,
+config hashing (SURVEY.md §5 parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from madsim_tpu import Runtime, SimConfig, NetConfig, ms, sec
+from madsim_tpu.harness.determinism import find_divergence
+from madsim_tpu.models.pingpong import PingPong, state_spec
+from madsim_tpu.runtime import checkpoint
+from madsim_tpu.runtime.trace import format_trace
+from madsim_tpu.core import types as T
+
+
+def _rt(target=20):
+    cfg = SimConfig(n_nodes=3, time_limit=sec(30))
+    return Runtime(cfg, [PingPong(3, target=target)], state_spec())
+
+
+class TestCheckpoint:
+    def test_save_resume_matches_straight_run(self, tmp_path):
+        rt = _rt()
+        seeds = np.arange(16)
+        # straight run
+        full, _ = rt.run(rt.init_batch(seeds), 4000)
+        # run half, checkpoint, reload, resume
+        half, _ = rt.run(rt.init_batch(seeds), 512, chunk=512)
+        p = str(tmp_path / "ckpt.npz")
+        checkpoint.save(p, half)
+        loaded = checkpoint.load(p, rt.init_batch(seeds))
+        resumed, _ = rt.run(loaded, 4000)
+        assert (rt.fingerprints(full) == rt.fingerprints(resumed)).all()
+
+    def test_load_rejects_wrong_shape(self, tmp_path):
+        rt = _rt()
+        s = rt.init_batch(np.arange(4))
+        p = str(tmp_path / "ckpt.npz")
+        checkpoint.save(p, s)
+        with pytest.raises(ValueError):
+            checkpoint.load(p, rt.init_batch(np.arange(8)))
+
+
+class TestTrace:
+    def test_format_trace_renders_events(self):
+        rt = _rt(target=3)
+        state, events = rt.run_single(5, 2000, collect_events=True)
+        lines = format_trace(events, 0)
+        assert len(lines) > 10
+        assert any("SUPER" in l and "INIT" in l for l in lines)
+        assert any("MSG" in l for l in lines)
+        assert any("TIMER" in l for l in lines)
+        # time filter drops early records
+        filtered = format_trace(events, 0, time_start=T.ms(5))
+        assert len(filtered) < len(lines)
+
+
+class TestDivergence:
+    def test_no_divergence_on_deterministic_program(self):
+        rt = _rt(target=5)
+        assert find_divergence(rt, seed=3, max_steps=2000) is None

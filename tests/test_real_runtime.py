@@ -1,0 +1,70 @@
+"""Dual-world tests: the SAME Program classes that run vectorized in the
+simulator run here against real asyncio time and real UDP sockets — the
+test-both-worlds idiom of the reference's CI (ci.yml runs the suite with and
+without --cfg madsim; SURVEY.md §4.5)."""
+
+import numpy as np
+import pytest
+
+from madsim_tpu import SimConfig
+from madsim_tpu.core.types import ms, sec
+from madsim_tpu.models.pingpong import PingPong, state_spec
+from madsim_tpu.models.rpc_echo import (EchoClient, EchoServer,
+                                        server_state_spec)
+from madsim_tpu.real.runtime import RealRuntime
+
+
+class TestRealWorld:
+    def test_pingpong_over_real_udp(self):
+        n = 3
+        cfg = SimConfig(n_nodes=n, time_limit=sec(10))
+        rt = RealRuntime(cfg, [PingPong(n, target=5, retry=ms(30))],
+                         state_spec(), base_port=19300)
+        rt.run(duration=5.0)
+        assert not rt.crashed
+        st0 = rt.states()[0]
+        assert int(st0["acked"]) >= 5           # pinger finished over UDP
+        got = sum(int(s["pings_got"]) for s in rt.states()[1:])
+        assert got >= 5
+
+    def test_echo_service_over_real_udp(self):
+        cfg = SimConfig(n_nodes=4, time_limit=sec(10))
+        rt = RealRuntime(cfg, [EchoServer(), EchoClient(target=5,
+                                                        timeout=ms(50))],
+                         server_state_spec(), node_prog=[0, 1, 1, 1],
+                         base_port=19320)
+        rt.run(duration=5.0)
+        assert not rt.crashed
+        acked = [int(s["acked"]) for s in rt.states()[1:]]
+        assert all(a >= 5 for a in acked), acked
+        assert int(rt.states()[0]["served"]) >= 15
+
+    def test_kill_restart_real(self):
+        # supervisor surface works against real sockets: kill a responder
+        # mid-run, restart it, the pinger's retries recover
+        import asyncio
+
+        n = 2
+        cfg = SimConfig(n_nodes=n, time_limit=sec(10))
+        rt = RealRuntime(cfg, [PingPong(n, target=8, retry=ms(30))],
+                         state_spec(), base_port=19340)
+
+        async def scenario():
+            rt._loop = asyncio.get_running_loop()
+            rt.t0 = __import__("time").monotonic()
+            for i in range(n):
+                await rt.start_node(i)
+            await asyncio.sleep(0.15)
+            rt.kill(1)
+            await asyncio.sleep(0.4)
+            await rt.restart(1)
+            try:
+                await asyncio.wait_for(rt._halted.wait(), timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+            for i in range(n):
+                rt.kill(i)
+
+        asyncio.run(scenario())
+        assert not rt.crashed
+        assert int(rt.states()[0]["acked"]) >= 8
