@@ -37,7 +37,11 @@ def as_payload(payload, n_words: int) -> jax.Array:
         return jnp.concatenate(
             [vec, jnp.zeros((n_words - len(items),), jnp.int32)])
     arr = jnp.asarray(payload, jnp.int32)
-    assert arr.shape == (n_words,), f"payload shape {arr.shape} != ({n_words},)"
+    assert arr.ndim == 1 and arr.shape[0] <= n_words, \
+        f"payload shape {arr.shape} too wide for ({n_words},)"
+    if arr.shape[0] < n_words:
+        arr = jnp.concatenate(
+            [arr, jnp.zeros((n_words - arr.shape[0],), jnp.int32)])
     return arr
 
 

@@ -186,9 +186,6 @@ class Raft(Program):
         st["voted_for"] = jnp.where(is_el, ctx.node, st["voted_for"])
         st["votes"] = jnp.where(is_el, 1, st["votes"])
         last_t = self._last_term(st)
-        for p in range(self.npeers):
-            ctx.send(p, RV, [st["term"], st["log_len"], last_t],
-                     when=is_el & (p != ctx.node))
         self._arm_election(ctx, st, is_el)  # candidate retries on split vote
 
         # heartbeat / replication tick (leader only). AE payload layout:
@@ -196,6 +193,13 @@ class Raft(Program):
         #  *ENTRY_FIELDS, has_entry]
         is_hb = ((tag == T_HEARTBEAT) & (payload[0] == st["hgen"])
                  & (st["role"] == LEADER))
+        # election RV and heartbeat AE broadcasts are mutually exclusive,
+        # so they SHARE send slots — per-peer emission count (the dominant
+        # per-step engine cost) is npeers, not 2*npeers
+        zero = jnp.zeros_like(st["term"])
+        rv_payload = jnp.stack(
+            [st["term"], st["log_len"], last_t]
+            + [zero] * (3 + len(self.ENTRY_FIELDS)))
         for p in range(self.npeers):
             nxt = st["next_idx"][p]
             has = nxt < st["log_len"]
@@ -203,12 +207,14 @@ class Raft(Program):
                                   st["log_term"][jnp.clip(nxt - 1, 0, L - 1)],
                                   0)
             eidx = jnp.clip(nxt, 0, L - 1)
-            ctx.send(p, AE,
-                     [st["term"], nxt, prev_term, st["commit"],
-                      st["log_term"][eidx]]
-                     + [st[f"log_{f}"][eidx] for f in self.ENTRY_FIELDS]
-                     + [has.astype(jnp.int32)],
-                     when=is_hb & (p != ctx.node))
+            ae_payload = jnp.stack(
+                [st["term"], nxt, prev_term, st["commit"],
+                 st["log_term"][eidx]]
+                + [st[f"log_{f}"][eidx] for f in self.ENTRY_FIELDS]
+                + [has.astype(jnp.int32)])
+            ctx.send(p, jnp.where(is_el, RV, AE),
+                     jnp.where(is_el, rv_payload, ae_payload),
+                     when=(is_el | is_hb) & (p != ctx.node))
         ctx.set_timer(self.hb, T_HEARTBEAT, [st["hgen"]], when=is_hb)
 
         # self-proposing client: leaders append a fresh command

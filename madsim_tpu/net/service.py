@@ -1,0 +1,70 @@
+"""Service sugar: the `#[madsim::service]` / tonic-server analog.
+
+The reference macro scans an impl block for `#[rpc]` methods and generates a
+`serve()` that registers each as a tag handler, deriving stable request IDs
+by const-hashing the type path (madsim-macros/src/service.rs:61-111,
+net/rpc.rs:81-91 `hash_str`). The state-machine analog: subclass `Service`,
+decorate methods with `@rpc`, and the base class's `on_message` dispatches
+by a stable per-method tag (same hash idea) and sends the reply — every
+method body runs each event (SIMD), gated by its `when` mask.
+
+    class Counter(Service):
+        @rpc
+        def add(self, ctx, st, payload, when):
+            st["total"] = st["total"] + jnp.where(when, payload[1], 0)
+            return [st["total"]]          # reply body
+
+    client side: net.rpc.call(ctx, server, Counter.add.tag, [5], call_id,
+                              retry_timer_tag=..., timeout=...)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.api import Ctx, Program
+from . import rpc as _rpc
+
+
+def _hash33(s: str) -> int:
+    """Stable 31-bit string hash (the hash_str const-fn shape,
+    rpc.rs:81-91) for deriving method tags from qualified names."""
+    h = 5381
+    for c in s.encode():
+        h = (h * 33 + c) & 0x7FFFFFFF
+    return h | 1  # never 0, keep positive, below the REPLY_BIT
+
+
+def rpc(fn):
+    """Mark a Service method as an RPC handler. The method receives
+    (ctx, st, payload, when) and returns the reply body (list of int32
+    words); its tag is `Method.tag`."""
+    fn._rpc_tag = _hash33(fn.__qualname__) % (1 << 29)
+    fn.tag = fn._rpc_tag
+    return fn
+
+
+class Service(Program):
+    """Base class dispatching tagged requests to @rpc methods and sending
+    replies with the net.rpc call-id convention."""
+
+    def _handlers(self):
+        hs = []
+        for name in dir(type(self)):
+            m = getattr(type(self), name)
+            if callable(m) and hasattr(m, "_rpc_tag"):
+                hs.append(m)
+        hs.sort(key=lambda m: m._rpc_tag)
+        tags = [m._rpc_tag for m in hs]
+        assert len(set(tags)) == len(tags), (
+            f"@rpc tag hash collision in {type(self).__name__}: "
+            f"{[m.__qualname__ for m in hs]} — rename a method")
+        return hs
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        for m in self._handlers():
+            when = tag == m._rpc_tag
+            body = m(self, ctx, st, payload, when)
+            _rpc.reply(ctx, src, m._rpc_tag, payload, list(body), when=when)
+        ctx.state = st

@@ -1,0 +1,124 @@
+"""Reliable ordered streams over the unordered, lossy message layer — the
+simulated-TCP analog.
+
+The reference gives applications `TcpStream` objects backed by an in-memory
+duplex ring buffer with loss-free FIFO delivery (sim/net/tcp/stream.rs:
+96-126), while its datagram Endpoint may drop and reorder. Here the same
+split exists: the engine's messages are UDP-like (latency jitter reorders,
+loss drops, clogs block), and this module layers TCP semantics on top as a
+state-machine library: sliding-window transmission, cumulative acks,
+timer-driven retransmission, exactly-once in-order delivery. Window slots
+are a fixed ring (seq % window), so everything is static-shape and
+vectorizes across the seed batch.
+
+Usage inside a Program (see tests/test_stream.py):
+    spec = {**my_spec, **stream.stream_state(n_nodes, window=4)}
+    # sender:  stream.send(ctx, st, dst, value, when=...)
+    #          stream.retransmit(ctx, st, dst, when=timer_fired)
+    # receiver (in on_message):
+    #          vals, mask = stream.on_message(ctx, st, src, tag, payload)
+    #          -> up to `window` values delivered IN ORDER this event
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.api import Ctx
+
+TAG_DATA = 1 << 20
+TAG_ACK = (1 << 20) + 1
+
+
+def stream_state(n_nodes: int, window: int = 4):
+    """Per-node stream state: one bidirectional stream per peer."""
+    N, W = n_nodes, window
+    z = jnp.zeros((N,), jnp.int32)
+    return dict(
+        sx_seq=z,                                  # next seq to assign (tx)
+        sx_base=z,                                 # lowest unacked seq
+        sx_val=jnp.zeros((N, W), jnp.int32),       # unacked ring
+        sr_next=z,                                 # next expected seq (rx)
+        sr_val=jnp.zeros((N, W), jnp.int32),       # out-of-order ring
+        sr_have=jnp.zeros((N, W), bool),
+    )
+
+
+def _window(st):
+    return st["sr_have"].shape[1]
+
+
+def send(ctx: Ctx, st, dst, val, *, when=True):
+    """Enqueue one value on the stream to `dst` and transmit it. Refused
+    (returns False mask) when the send window is full — like a TCP write
+    blocking on a full buffer (stream.rs:185-209)."""
+    W = _window(st)
+    dst = jnp.asarray(dst, jnp.int32)
+    seq = st["sx_seq"][dst]
+    room = (seq - st["sx_base"][dst]) < W
+    ok = jnp.asarray(when) & room
+    slot = seq % W
+    st["sx_val"] = st["sx_val"].at[dst, slot].set(
+        jnp.where(ok, val, st["sx_val"][dst, slot]))
+    st["sx_seq"] = st["sx_seq"].at[dst].set(seq + ok)
+    ctx.send(dst, TAG_DATA, [seq, val], when=ok)
+    return ok
+
+
+def retransmit(ctx: Ctx, st, dst, *, when=True):
+    """Resend every unacked value to `dst` (cumulative-ack Go-Back-N).
+    Arm a periodic timer and call this on fire."""
+    W = _window(st)
+    dst = jnp.asarray(dst, jnp.int32)
+    base, nxt = st["sx_base"][dst], st["sx_seq"][dst]
+    for i in range(W):
+        seq = base + i
+        live = jnp.asarray(when) & (seq < nxt)
+        ctx.send(dst, TAG_DATA, [seq, st["sx_val"][dst, seq % W]], when=live)
+
+
+def on_message(ctx: Ctx, st, src, tag, payload):
+    """Feed a received message through the stream layer.
+
+    Returns (vals, mask): up to `window` values newly deliverable IN ORDER
+    (mask[i] marks validity; process them with masked ops). Non-stream tags
+    return an all-False mask — safe to call unconditionally.
+    """
+    W = _window(st)
+    src = jnp.asarray(src, jnp.int32)
+
+    # ---- DATA: buffer in-window segments, deliver the contiguous run ----
+    is_data = tag == TAG_DATA
+    seq, val = payload[0], payload[1]
+    nxt = st["sr_next"][src]
+    in_win = is_data & (seq >= nxt) & (seq < nxt + W)
+    slot = seq % W
+    st["sr_val"] = st["sr_val"].at[src, slot].set(
+        jnp.where(in_win, val, st["sr_val"][src, slot]))
+    st["sr_have"] = st["sr_have"].at[src, slot].set(
+        st["sr_have"][src, slot] | in_win)
+
+    # longest contiguous run starting at sr_next (exactly-once, in-order)
+    offs = jnp.arange(W, dtype=jnp.int32)
+    have_seq = st["sr_have"][src, (nxt + offs) % W]
+    run = jnp.cumprod(have_seq.astype(jnp.int32))      # 1,1,..,0,..
+    count = run.sum()
+    deliver = is_data & (run == 1)
+    vals = st["sr_val"][src, (nxt + offs) % W]
+    # clear delivered slots, advance the window
+    st["sr_have"] = st["sr_have"].at[src, (nxt + offs) % W].set(
+        jnp.where(deliver, False, st["sr_have"][src, (nxt + offs) % W]))
+    st["sr_next"] = st["sr_next"].at[src].set(
+        nxt + jnp.where(is_data, count, 0))
+    # cumulative ack (also for duplicates below the window — re-ack)
+    ctx.send(src, TAG_ACK, [st["sr_next"][src]], when=is_data)
+
+    # ---- ACK: slide the send window ------------------------------------
+    is_ack = tag == TAG_ACK
+    cum = payload[0]
+    st["sx_base"] = st["sx_base"].at[src].set(
+        jnp.where(is_ack,
+                  jnp.clip(cum, st["sx_base"][src], st["sx_seq"][src]),
+                  st["sx_base"][src]))
+
+    return vals, deliver

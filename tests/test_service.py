@@ -1,0 +1,103 @@
+"""Service-layer tests: @rpc dispatch, stable tags, full client-server flow
+under loss (the tonic-example idiom with the macro sugar)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu import Program, Runtime, SimConfig, NetConfig, ms, sec
+from madsim_tpu.harness.simtest import run_seeds
+from madsim_tpu.net import rpc
+from madsim_tpu.net.service import Service, rpc as rpc_method
+
+T_RETRY = 1
+
+
+class Calc(Service):
+    @rpc_method
+    def add(self, ctx, st, payload, when):
+        st["total"] = st["total"] + jnp.where(when, payload[1], 0)
+        return [st["total"]]
+
+    @rpc_method
+    def mul(self, ctx, st, payload, when):
+        st["total"] = st["total"] * jnp.where(when, payload[1], 1)
+        return [st["total"]]
+
+
+class Driver(Program):
+    """Client: add(3) x4 then mul(2), expect 24, assert via crash_if."""
+
+    STEPS = [(Calc.add.tag, 3)] * 4 + [(Calc.mul.tag, 2)]
+
+    def init(self, ctx):
+        st = dict(ctx.state)
+        st["call_id"] = rpc.new_call_id(ctx)
+        rpc.call(ctx, 0, Calc.add.tag, [3], st["call_id"],
+                 retry_timer_tag=T_RETRY, timeout=ms(40))
+        ctx.state = st
+
+    def _step_tag(self, i):
+        tags = jnp.asarray([t for t, _ in self.STEPS], jnp.int32)
+        args = jnp.asarray([a for _, a in self.STEPS], jnp.int32)
+        i = jnp.clip(i, 0, len(self.STEPS) - 1)
+        return tags[i], args[i]
+
+    def on_timer(self, ctx, tag, payload):
+        st = dict(ctx.state)
+        retry = ((tag == T_RETRY) & (payload[0] == st["call_id"])
+                 & (st["step"] < len(self.STEPS)))
+        t, a = self._step_tag(st["step"])
+        rpc.call(ctx, 0, t, [a], st["call_id"],
+                 retry_timer_tag=T_RETRY, timeout=ms(40), when=retry)
+        ctx.state = st
+
+    def on_message(self, ctx, src, tag, payload):
+        st = dict(ctx.state)
+        hit = rpc.is_reply(tag) & rpc.matches(payload, st["call_id"])
+        st["step"] = st["step"] + hit
+        done = st["step"] >= len(self.STEPS)
+        # final reply carries the computed total
+        ctx.crash_if(hit & done & (payload[1] != 24), 301)
+        new_id = rpc.new_call_id(ctx)
+        t, a = self._step_tag(st["step"])
+        rpc.call(ctx, 0, t, [a], new_id,
+                 retry_timer_tag=T_RETRY, timeout=ms(40), when=hit & ~done)
+        st["call_id"] = jnp.where(hit & ~done, new_id, st["call_id"])
+        ctx.halt_if(hit & done & (ctx.node == 1))
+        ctx.state = st
+
+
+def _spec():
+    z = jnp.asarray(0, jnp.int32)
+    return dict(total=z, call_id=z, step=z)
+
+
+class TestService:
+    def test_tags_stable_and_distinct(self):
+        assert Calc.add.tag != Calc.mul.tag
+        assert Calc.add.tag == Calc.add.tag  # stable within process
+        assert 0 < Calc.add.tag < (1 << 29)
+
+    def test_calc_flow_clean(self):
+        cfg = SimConfig(n_nodes=2, time_limit=sec(20))
+        rt = Runtime(cfg, [Calc(), Driver()], _spec(), node_prog=[0, 1])
+        state = run_seeds(rt, np.arange(8), max_steps=10_000)
+        assert (np.asarray(state.node_state["total"])[:, 0] == 24).all()
+
+    def test_calc_flow_under_loss(self):
+        # NOTE: add/mul are not idempotent; loss-with-retry would legally
+        # double-apply (at-least-once). Dedup belongs to the app layer
+        # (raft_kv does it); here we only check the service still answers.
+        cfg = SimConfig(n_nodes=2, time_limit=sec(20),
+                        net=NetConfig(packet_loss_rate=0.2))
+        rt = Runtime(cfg, [Calc(), Driver()], _spec(), node_prog=[0, 1])
+        state, _ = rt.run(rt.init_batch(np.arange(8)), 20_000)
+        # weaker check than the clean test: crash 301 may legitimately fire
+        # for double-applied retries (at-least-once), so only require the
+        # service kept answering — non-crashed halted seeds made all 5 steps
+        steps = np.asarray(state.node_state["step"])[:, 1]
+        halted = np.asarray(state.halted)
+        crashed = np.asarray(state.crashed)
+        done_ok = halted & ~crashed
+        assert halted.any()
+        assert (steps[done_ok] >= 5).all()
