@@ -36,6 +36,10 @@ def as_payload(payload, n_words: int) -> jax.Array:
         vec = jnp.stack(items) if items else jnp.zeros((0,), jnp.int32)
         return jnp.concatenate(
             [vec, jnp.zeros((n_words - len(items),), jnp.int32)])
+    # Array payloads narrower than payload_words are DELIBERATELY
+    # zero-padded (protocols build exact-semantic-width stacks, e.g. raft's
+    # merged RV/AE payload); word-layout correctness is the protocol's
+    # responsibility — decode reads fixed positions.
     arr = jnp.asarray(payload, jnp.int32)
     assert arr.ndim == 1 and arr.shape[0] <= n_words, \
         f"payload shape {arr.shape} too wide for ({n_words},)"

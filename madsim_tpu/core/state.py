@@ -63,6 +63,9 @@ class SimState:
     msg_sent: jax.Array
     msg_delivered: jax.Array
     msg_dropped: jax.Array
+    ev_peak: jax.Array      # int32 — high-water mark of occupied event rows
+                            # (capacity-tuning aid: size event_capacity to
+                            # the workload instead of guessing)
 
 
 def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any) -> SimState:
@@ -96,6 +99,7 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any) -> SimState:
         msg_sent=jnp.asarray(0, i32),
         msg_delivered=jnp.asarray(0, i32),
         msg_dropped=jnp.asarray(0, i32),
+        ev_peak=jnp.asarray(0, i32),
     )
 
 

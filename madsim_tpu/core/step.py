@@ -199,8 +199,10 @@ def make_step(
         E = n_sends + n_timers
         sent = delivered_drop = jnp.asarray(0, jnp.int32)
         overflow = jnp.asarray(False)
+        high_water = jnp.asarray(0, jnp.int32)
         if E > 0:
             free = s.t_kind == T.EV_FREE
+            occupied_now = (~free).sum(dtype=jnp.int32)
             slots, slot_ok = sel.first_k_free(free, E)
             net_keys = prng.split(k_net, 2 * max(n_sends, 1))
             em_write, em_deadline, em_kind = [], [], []
@@ -238,6 +240,7 @@ def make_step(
                 em_payload.append(e["payload"])
 
             w = jnp.stack(em_write)                      # [E] bool
+            high_water = occupied_now + w.sum(dtype=jnp.int32)
             # masked-off emissions scatter out of bounds and are dropped —
             # real slots are distinct, so the scatter has no index clashes
             slots_eff = jnp.where(w, slots,
@@ -260,6 +263,7 @@ def make_step(
             msg_delivered=s.msg_delivered + is_msg.astype(jnp.int32),
             msg_dropped=s.msg_dropped + delivered_drop
             + dropped.astype(jnp.int32),
+            ev_peak=jnp.maximum(s.ev_peak, high_water),
             oops=s.oops | jnp.where(overflow, T.OOPS_EVENT_OVERFLOW, 0)
             | jnp.where(s.now > T.T_INF - 64 * T.TICKS_PER_SEC,
                         T.OOPS_TIME_OVERFLOW, 0),

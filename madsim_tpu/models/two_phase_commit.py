@@ -1,0 +1,183 @@
+"""Two-phase commit — a second protocol family for the chaos harness.
+
+Coordinator (node 0) drives a sequence of transactions over participants
+1..N-1: PREPARE -> votes -> COMMIT iff every vote is yes, else ABORT ->
+acks. Votes and decisions are write-ahead state (engine persist mask), so a
+crashed coordinator re-drives its persisted decision after restart — the
+classic "2PC blocks on coordinator failure, but never diverges" behavior.
+
+The per-event global invariant is atomicity itself: no transaction may be
+COMMITted on one node and ABORTed on another, and a participant that voted
+NO must never see COMMIT. `early_decide_quorum` deliberately re-introduces
+the classic bug (deciding before all votes arrive) so tests can prove the
+fuzzer finds it and reports a reproducing seed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.api import Ctx, Program
+from ..core.types import ms
+
+# message tags
+PREPARE, VOTE, DECIDE, ACK = 1, 2, 3, 4
+# timer tags
+T_TICK = 1
+# decision encoding
+NONE, COMMIT, ABORT = 0, 1, 2
+
+CRASH_DIVERGED = 401        # same tx committed here, aborted there
+CRASH_NO_VOTE_COMMIT = 402  # committed against a NO vote
+
+
+def state_spec(n_nodes: int, n_tx: int):
+    z = jnp.asarray(0, jnp.int32)
+    return dict(
+        # persisted write-ahead state
+        voted=jnp.zeros((n_tx,), jnp.int32),    # NONE/COMMIT(yes)/ABORT(no)
+        decided=jnp.zeros((n_tx,), jnp.int32),  # NONE/COMMIT/ABORT
+        # coordinator volatile driving state
+        tx=z, phase=z,                           # 0 idle, 1 voting, 2 decide
+        votes_mask=z, no_seen=z, acks_mask=z,    # participant bitmasks
+    )
+
+
+def persist_spec():
+    return dict(voted=True, decided=True, tx=False, phase=False,
+                votes_mask=False, no_seen=False, acks_mask=False)
+
+
+class TwoPhaseCommit(Program):
+    def __init__(self, n_nodes: int, n_tx: int = 6, p_yes: float = 0.85,
+                 tick=ms(30), early_decide_quorum: int | None = None):
+        assert n_nodes <= 31
+        self.n = n_nodes
+        self.tx_count = n_tx
+        self.p_yes = p_yes
+        self.tick = tick
+        # BUG KNOB: decide once this many votes arrived (None = all — correct)
+        self.early_quorum = early_decide_quorum
+        self.all_mask = 0
+        for p in range(1, n_nodes):
+            self.all_mask |= 1 << p
+
+    # -- coordinator ------------------------------------------------------
+    def init(self, ctx: Ctx):
+        ctx.set_timer(ctx.randint(0, self.tick), T_TICK,
+                      when=ctx.node == 0)
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        is_tick = (tag == T_TICK) & (ctx.node == 0)
+        running = st["tx"] < self.tx_count
+        t = jnp.clip(st["tx"], 0, self.tx_count - 1)
+
+        # idle -> start the next transaction
+        start = is_tick & running & (st["phase"] == 0)
+        st["phase"] = jnp.where(start, 1, st["phase"])
+        st["votes_mask"] = jnp.where(start, 0, st["votes_mask"])
+        st["no_seen"] = jnp.where(start, 0, st["no_seen"])
+        st["acks_mask"] = jnp.where(start, 0, st["acks_mask"])
+
+        # voting phase: (re)send PREPARE to participants lacking a vote
+        voting = is_tick & running & ((st["phase"] == 1) | start)
+        n_votes = _popcount(st["votes_mask"], self.n)
+        need = (self.n - 1 if self.early_quorum is None
+                else self.early_quorum)
+        complete = voting & (n_votes >= need)
+        # recovery rule: a persisted decision is FINAL — a restarted
+        # coordinator re-drives it rather than re-deciding
+        decision = jnp.where(st["decided"][t] != NONE, st["decided"][t],
+                             jnp.where(st["no_seen"] != 0, ABORT, COMMIT))
+        st["decided"] = st["decided"].at[t].set(
+            jnp.where(complete, decision, st["decided"][t]))  # WAL write
+        st["phase"] = jnp.where(complete, 2, st["phase"])
+
+        # decide phase: (re)send DECIDE to un-acked participants
+        deciding = is_tick & running & (st["phase"] == 2)
+        for p in range(1, self.n):
+            bit = 1 << p
+            ctx.send(p, jnp.where(deciding, DECIDE, PREPARE),
+                     [t, st["decided"][t]],
+                     when=(voting & ~complete & ((st["votes_mask"] & bit) == 0))
+                     | (deciding & ((st["acks_mask"] & bit) == 0)))
+
+        # all acked -> next transaction
+        done = deciding & ((st["acks_mask"] & self.all_mask) == self.all_mask)
+        st["tx"] = st["tx"] + done
+        st["phase"] = jnp.where(done, 0, st["phase"])
+
+        ctx.set_timer(self.tick, T_TICK, when=is_tick & running)
+        ctx.halt_if((ctx.node == 0) & (st["tx"] >= self.tx_count))
+        ctx.state = st
+
+    # -- both roles -------------------------------------------------------
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        t = jnp.clip(payload[0], 0, self.tx_count - 1)
+
+        # participant: PREPARE -> vote once (persisted), resend same vote
+        is_prep = (tag == PREPARE) & (ctx.node != 0)
+        fresh = is_prep & (st["voted"][t] == NONE)
+        vote = jnp.where(ctx.bernoulli(self.p_yes), COMMIT, ABORT)
+        st["voted"] = st["voted"].at[t].set(
+            jnp.where(fresh, vote, st["voted"][t]))
+        ctx.send(src, VOTE, [t, st["voted"][t], ctx.node], when=is_prep)
+
+        # participant: DECIDE -> record + ack; atomicity asserts
+        is_dec = (tag == DECIDE) & (ctx.node != 0)
+        d = payload[1]
+        ctx.crash_if(is_dec & (st["voted"][t] == ABORT) & (d == COMMIT),
+                     CRASH_NO_VOTE_COMMIT)
+        st["decided"] = st["decided"].at[t].set(
+            jnp.where(is_dec & (st["decided"][t] == NONE), d,
+                      st["decided"][t]))
+        ctx.send(src, ACK, [t, ctx.node], when=is_dec)
+
+        # coordinator: collect votes / acks
+        is_vote = (tag == VOTE) & (ctx.node == 0) & (t == jnp.clip(
+            st["tx"], 0, self.tx_count - 1))
+        voter_bit = 1 << jnp.clip(payload[2], 0, 30)
+        st["votes_mask"] = jnp.where(is_vote, st["votes_mask"] | voter_bit,
+                                     st["votes_mask"])
+        st["no_seen"] = jnp.where(is_vote & (payload[1] == ABORT),
+                                  st["no_seen"] | voter_bit, st["no_seen"])
+        # ACKs are tx-guarded like votes: a stale duplicate ACK from the
+        # previous transaction must not pre-mark a participant as acked
+        is_ack = ((tag == ACK) & (ctx.node == 0)
+                  & (t == jnp.clip(st["tx"], 0, self.tx_count - 1)))
+        ack_bit = 1 << jnp.clip(payload[1], 0, 30)
+        st["acks_mask"] = jnp.where(is_ack, st["acks_mask"] | ack_bit,
+                                    st["acks_mask"])
+        ctx.state = st
+
+
+def _popcount(x, n_bits):
+    bits = (x[None] >> jnp.arange(n_bits, dtype=jnp.int32)) & 1
+    return bits.sum(dtype=jnp.int32)
+
+
+def tpc_invariant(n_nodes: int, n_tx: int):
+    """Atomicity: a transaction never COMMITs on one node and ABORTs on
+    another (checked across all nodes after every event)."""
+    def invariant(state):
+        dec = state.node_state["decided"]            # [N, TX]
+        committed = (dec == COMMIT).any(axis=0)
+        aborted = (dec == ABORT).any(axis=0)
+        bad = (committed & aborted).any()
+        return bad, jnp.asarray(CRASH_DIVERGED, jnp.int32)
+    return invariant
+
+
+def make_tpc_runtime(n_nodes=5, n_tx=6, scenario=None, cfg=None, **kw):
+    from ..core.types import SimConfig, sec
+    from ..runtime.runtime import Runtime
+    if cfg is None:
+        cfg = SimConfig(n_nodes=n_nodes, event_capacity=128,
+                        time_limit=sec(20))
+    prog = TwoPhaseCommit(n_nodes, n_tx, **kw)
+    return Runtime(cfg, [prog], state_spec(n_nodes, n_tx),
+                   scenario=scenario, invariant=tpc_invariant(n_nodes, n_tx),
+                   persist=persist_spec())

@@ -63,8 +63,26 @@ class Service(Program):
 
     def on_message(self, ctx: Ctx, src, tag, payload):
         st = dict(ctx.state)
-        for m in self._handlers():
+        # handler tags are mutually exclusive, so all replies SHARE one send
+        # slot (the emission-count discipline of raft's merged broadcasts)
+        hs = self._handlers()
+        width = 0
+        merged_tag = jnp.asarray(0, jnp.int32)
+        merged_when = jnp.asarray(False)
+        bodies = []
+        for m in hs:
             when = tag == m._rpc_tag
-            body = m(self, ctx, st, payload, when)
-            _rpc.reply(ctx, src, m._rpc_tag, payload, list(body), when=when)
+            body = [jnp.asarray(wd, jnp.int32) for wd in
+                    m(self, ctx, st, payload, when)]
+            bodies.append((when, body))
+            width = max(width, len(body))
+            merged_tag = jnp.where(when, m._rpc_tag, merged_tag)
+            merged_when = merged_when | when
+        zero = jnp.asarray(0, jnp.int32)
+        merged_body = [zero] * width
+        for when, body in bodies:
+            for i, wd in enumerate(body):
+                merged_body[i] = jnp.where(when, wd, merged_body[i])
+        ctx.send(src, _rpc.reply_tag(merged_tag),
+                 [payload[0]] + merged_body, when=merged_when)
         ctx.state = st

@@ -1,0 +1,37 @@
+"""Recurring-timer semantics: tokio's MissedTickBehavior, state-machine
+style (reference: sim/time/interval.rs:62-69).
+
+In the state-machine world an interval is a self-rearming timer; what needs
+parity is the policy when ticks are missed (node paused, event storm).
+`next_tick` computes the next deadline given the tick that just fired:
+
+  BURST: fire all missed ticks back-to-back (schedule at scheduled+period,
+         even if that is already in the past — it fires immediately).
+  DELAY: restart the cadence from now.
+  SKIP:  jump to the next multiple of the period after now.
+
+Usage in on_timer (payload carries the scheduled time):
+    nxt = next_tick(ctx.now, payload[0], period, SKIP)
+    ctx.set_timer(nxt - ctx.now, MY_TICK, [nxt], when=...)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+BURST, DELAY, SKIP = 0, 1, 2
+
+
+def next_tick(now, scheduled, period, behavior: int):
+    now = jnp.asarray(now, jnp.int32)
+    scheduled = jnp.asarray(scheduled, jnp.int32)
+    period = jnp.asarray(period, jnp.int32)
+    burst = scheduled + period
+    delay = now + period
+    missed = jnp.maximum(now - scheduled, 0) // period + 1
+    skip = scheduled + missed * period
+    if behavior == BURST:
+        return burst
+    if behavior == DELAY:
+        return delay
+    return skip

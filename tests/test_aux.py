@@ -60,3 +60,15 @@ class TestDivergence:
     def test_no_divergence_on_deterministic_program(self):
         rt = _rt(target=5)
         assert find_divergence(rt, seed=3, max_steps=2000) is None
+
+
+class TestInterval:
+    def test_missed_tick_behaviors(self):
+        from madsim_tpu.utils.interval import BURST, DELAY, SKIP, next_tick
+        # tick scheduled at 100, period 50, fired late at 230
+        assert int(next_tick(230, 100, 50, BURST)) == 150   # burn backlog
+        assert int(next_tick(230, 100, 50, DELAY)) == 280   # restart cadence
+        assert int(next_tick(230, 100, 50, SKIP)) == 250    # next multiple
+        # on-time tick: all behaviors agree
+        assert int(next_tick(100, 100, 50, BURST)) == 150
+        assert int(next_tick(100, 100, 50, SKIP)) == 150

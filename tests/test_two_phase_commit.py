@@ -1,0 +1,75 @@
+"""Two-phase commit fuzz: atomicity under loss and coordinator crashes, and
+the seeded early-decide bug being caught with a reproducing seed."""
+
+import numpy as np
+import pytest
+
+from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
+from madsim_tpu.harness.simtest import SimFailure, run_seeds
+from madsim_tpu.models import two_phase_commit as TPC
+from madsim_tpu.models.two_phase_commit import make_tpc_runtime
+
+N, TX = 5, 6
+SEEDS = np.arange(8)
+
+
+def _cfg(loss=0.0, time_limit=sec(20)):
+    return SimConfig(n_nodes=N, event_capacity=128, time_limit=time_limit,
+                     net=NetConfig(packet_loss_rate=loss,
+                                   send_latency_min=ms(1),
+                                   send_latency_max=ms(10)))
+
+
+class TestTwoPhaseCommit:
+    def test_clean_run_atomic_and_complete(self):
+        rt = make_tpc_runtime(N, TX, cfg=_cfg())
+        state = run_seeds(rt, SEEDS, max_steps=20_000)
+        dec = np.asarray(state.node_state["decided"])  # [B, N, TX]
+        # every tx decided on every participant, identically
+        assert (dec[:, 1:, :] != TPC.NONE).all()
+        for b in range(len(SEEDS)):
+            for t in range(TX):
+                vals = set(dec[b, 1:, t].tolist())
+                assert len(vals) == 1, f"seed {b} tx {t} diverged: {vals}"
+        # with p_yes=0.85^4 ~ 52%, both outcomes occur across the batch
+        assert (dec == TPC.COMMIT).any() and (dec == TPC.ABORT).any()
+
+    def test_loss_and_coordinator_crash_stays_atomic(self):
+        sc = Scenario()
+        sc.at(ms(100)).kill(0)
+        sc.at(ms(600)).restart(0)
+        sc.at(ms(900)).kill(0)
+        sc.at(ms(1400)).restart(0)
+        rt = make_tpc_runtime(N, TX, scenario=sc,
+                              cfg=_cfg(loss=0.1, time_limit=sec(30)))
+        state = run_seeds(rt, SEEDS, max_steps=60_000)
+        dec = np.asarray(state.node_state["decided"])
+        for b in range(len(SEEDS)):
+            for t in range(TX):
+                vals = set(dec[b, 1:, t].tolist()) - {TPC.NONE}
+                assert len(vals) <= 1  # never both COMMIT and ABORT
+
+    def test_early_decide_bug_caught(self):
+        # decide after 2 of 4 votes under loss: a missing NO vote wrongly
+        # commits; the NO-voter's assert (or the global invariant) fires
+        rt = make_tpc_runtime(N, TX, early_decide_quorum=2, p_yes=0.6,
+                              cfg=_cfg(loss=0.15, time_limit=sec(30)))
+        with pytest.raises(SimFailure) as ei:
+            run_seeds(rt, np.arange(48), max_steps=60_000)
+        assert ei.value.code in (TPC.CRASH_DIVERGED, TPC.CRASH_NO_VOTE_COMMIT)
+        # the reported seed reproduces alone
+        state, _ = rt.run_single(ei.value.seed, max_steps=60_000)
+        assert bool(state.crashed.all())
+
+    def test_determinism(self):
+        rt = make_tpc_runtime(N, TX, cfg=_cfg(loss=0.05))
+        assert rt.check_determinism(seed=99, max_steps=20_000)
+
+    def test_fast_tick_duplicate_acks_still_complete(self):
+        # regression: tick < 2*max latency retransmits DECIDE while its ACK
+        # is in flight; stale duplicate ACKs must not pre-ack the next tx
+        # (which would leave its DECIDE unsent and decided[k] = NONE)
+        rt = make_tpc_runtime(N, TX, tick=ms(12), cfg=_cfg())
+        state = run_seeds(rt, np.arange(16), max_steps=40_000)
+        dec = np.asarray(state.node_state["decided"])
+        assert (dec[:, 1:, :] != TPC.NONE).all()
