@@ -1,0 +1,62 @@
+"""Extensions: the pluggable-simulator framework.
+
+madsim lets user crates register custom resource simulators keyed by TypeId
+— `trait Simulator { new, create_node, reset_node }` plus
+`plugin::simulator::<S>()` (sim/plugin.rs:13-40, registered via
+Runtime::add_simulator, runtime/mod.rs:66-76). The tensor-world analog: an
+Extension contributes
+  * its own per-trajectory state subtree (a named column group in SimState),
+  * handlers for custom supervisor opcodes (op >= OP_USER, schedulable from
+    a Scenario like any built-in fault op), and
+  * an optional per-event hook observing every dispatched event
+(all traced into the same jitted step, so extensions run at engine speed
+and vectorize over the seed batch like everything else).
+
+See tests/test_extension.py for a power-budget simulator example.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+# user opcode space: built-ins stay below, extensions at or above
+OP_USER = 100
+
+
+class Extension:
+    """Subclass and register via Runtime(extensions=[...])."""
+
+    #: unique key — the TypeId analog; also the SimState.ext dict key
+    name: str = "extension"
+
+    def state(self, cfg) -> Any:
+        """Default per-trajectory state subtree (pytree of jnp arrays)."""
+        return {}
+
+    def on_op(self, cfg, sub, op, target, src, payload, key):
+        """Handle a custom supervisor op (fires for ANY op >= OP_USER;
+        check `op` against your opcodes with masked updates). Returns the
+        updated subtree. `target`/`src`/`payload` come from the scenario
+        row; `key` is a per-event PRNG key."""
+        return sub
+
+    def on_event(self, cfg, sub, state, record) -> Any:
+        """Observe every dispatched event (record: now/kind/node/src/tag/
+        payload/fired) — the create_node/reset_node-style bookkeeping hook.
+        Returns the updated subtree. Masked no-op when record['fired'] is
+        False."""
+        return sub
+
+    def reset_node(self, cfg, sub, node, when):
+        """A node was killed or (re)booted (Simulator::reset_node analog,
+        plugin.rs:24). Returns the updated subtree."""
+        return sub
+
+
+def build_ext_state(cfg, extensions) -> dict:
+    names = [e.name for e in extensions]
+    assert len(set(names)) == len(names), f"duplicate extension names {names}"
+    return {e.name: jax.tree.map(lambda a: a, e.state(cfg))
+            for e in extensions}

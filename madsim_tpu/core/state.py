@@ -67,8 +67,12 @@ class SimState:
                             # (capacity-tuning aid: size event_capacity to
                             # the workload instead of guessing)
 
+    # --- extension state (plugin framework analog, plugin.rs) -------------
+    ext: Any                # dict: extension name -> its state subtree
 
-def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any) -> SimState:
+
+def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
+               ext_state: Any = None) -> SimState:
     """Fresh state for one trajectory. `node_state` must already carry the
     leading [N] axis (Runtime stacks the per-node spec)."""
     C, P, N = cfg.event_capacity, cfg.payload_words, cfg.n_nodes
@@ -100,6 +104,7 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any) -> SimState:
         msg_delivered=jnp.asarray(0, i32),
         msg_dropped=jnp.asarray(0, i32),
         ev_peak=jnp.asarray(0, i32),
+        ext=ext_state if ext_state is not None else {},
     )
 
 

@@ -65,6 +65,7 @@ def make_step(
     invariant: Callable[[SimState], tuple[jax.Array, jax.Array]] | None = None,
     persist: Any = None,
     halt_when: Callable[[SimState], jax.Array] | None = None,
+    extensions: Sequence = (),
 ) -> Callable[[SimState], tuple[SimState, dict[str, jax.Array]]]:
     """Build the per-trajectory step function.
 
@@ -140,8 +141,22 @@ def make_step(
         # ---- 2. supervisor op (Handle::kill/restart/... as events) ---------
         is_super = valid & (ev_kind == T.EV_SUPER)
         op = jnp.where(is_super, ev_tag, 0)
-        s, init_node = _apply_super(cfg, spec_default, persist_mask, s, op,
-                                    ev_node_raw, ev_src, ev_payload, k_super)
+        ext_keys = prng.split(k_super, 1 + max(len(extensions), 1))
+        s, init_node, reset_target, reset_mask = _apply_super(
+            cfg, spec_default, persist_mask, s, op, ev_node_raw, ev_src,
+            ev_payload, ext_keys[0])
+        # extension custom ops + node-reset hooks (plugin.rs analog).
+        # Extensions get the RESOLVED target so NODE_RANDOM scheduled ops
+        # work for custom opcodes exactly like for built-ins.
+        if extensions:
+            new_ext = dict(s.ext)
+            for i, e in enumerate(extensions):
+                sub = new_ext[e.name]
+                sub = e.on_op(cfg, sub, op, reset_target, ev_src, ev_payload,
+                              ext_keys[1 + i])
+                sub = e.reset_node(cfg, sub, reset_target, reset_mask)
+                new_ext[e.name] = sub
+            s = s.replace(ext=new_ext)
 
         # ---- 3. protocol handler dispatch ---------------------------------
         node_ok = s.alive[ev_node] & ~s.paused[ev_node]
@@ -304,6 +319,11 @@ def make_step(
             src=ev_src, tag=ev_tag, payload=ev_payload,
             fired=valid,
         )
+        if extensions:
+            new_ext = dict(s.ext)
+            for e in extensions:
+                new_ext[e.name] = e.on_event(cfg, new_ext[e.name], s, record)
+            s = s.replace(ext=new_ext)
         return s, record
 
     return live_step
@@ -402,4 +422,4 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     s = s.replace(t_kind=t_kind, t_deadline=t_deadline, alive=alive,
                   paused=paused, node_state=node_state, clog_node=clog_node,
                   clog_link=clog_link, loss=loss, lat_lo=lat_lo, lat_hi=lat_hi)
-    return s, init_node
+    return s, init_node, target, (kill | boot)

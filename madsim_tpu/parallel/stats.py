@@ -1,0 +1,50 @@
+"""Batch analytics: what the supervisor reads back from a seed sweep.
+
+The reference exposes per-run Stat{msg_count} (network.rs:82-85) and prints
+a repro line on failure. A batched runtime wants fleet-level reductions
+(SURVEY §7 L6: first-crash seed, coverage stats): crash histograms by code,
+schedule-space coverage (distinct terminal fingerprints), throughput
+figures. All cheap host-side numpy over the final device state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def summarize(rt, state, seeds=None) -> dict:
+    """One-call fleet report for a (finished or running) batched state."""
+    halted = np.asarray(state.halted)
+    crashed = np.asarray(state.crashed)
+    codes = np.asarray(state.crash_code)
+    now = np.asarray(state.now)
+    B = halted.shape[0]
+    seeds = (np.asarray(seeds) if seeds is not None
+             else np.arange(B))
+
+    crash_hist: dict[int, int] = {}
+    first_seed_by_code: dict[int, int] = {}
+    for i in np.nonzero(crashed)[0]:
+        c = int(codes[i])
+        crash_hist[c] = crash_hist.get(c, 0) + 1
+        first_seed_by_code.setdefault(c, int(seeds[i]))
+
+    fps = rt.fingerprints(state)
+    return dict(
+        batch=B,
+        halted=int(halted.sum()),
+        crashed=int(crashed.sum()),
+        crash_histogram=crash_hist,
+        first_seed_by_code=first_seed_by_code,
+        first_crash_seed=(int(seeds[np.argmax(crashed)])
+                          if crashed.any() else None),
+        virtual_time_mean_us=float(now.mean()),
+        virtual_time_max_us=int(now.max()),
+        events_total=int(np.asarray(state.steps).sum()),
+        msgs_sent=int(np.asarray(state.msg_sent).sum()),
+        msgs_dropped=int(np.asarray(state.msg_dropped).sum()),
+        ev_peak_max=int(np.asarray(state.ev_peak).max()),
+        # schedule-space coverage proxy: distinct terminal states
+        distinct_outcomes=int(len(np.unique(fps))),
+        oops=int((np.asarray(state.oops) != 0).sum()),
+    )

@@ -46,7 +46,8 @@ class Runtime:
                  scenario: Scenario | None = None,
                  invariant: Callable | None = None,
                  persist: Any = None,
-                 halt_when: Callable | None = None):
+                 halt_when: Callable | None = None,
+                 extensions: Sequence = ()):
         self.cfg = cfg
         self.programs = list(programs)
         self.state_spec = state_spec
@@ -61,9 +62,11 @@ class Runtime:
         if not self.scenario.has_halt():
             self.scenario.at(cfg.time_limit).halt()
         self.invariant = invariant
+        self.extensions = list(extensions)
         self._step = make_step(cfg, self.programs, self.node_prog,
                                self.state_spec, invariant, persist=persist,
-                               halt_when=halt_when)
+                               halt_when=halt_when,
+                               extensions=self.extensions)
         self._template = self._build_template()
 
     # ------------------------------------------------------------------
@@ -82,7 +85,9 @@ class Runtime:
             lambda a: jnp.broadcast_to(jnp.asarray(a),
                                        (cfg.n_nodes,) + jnp.asarray(a).shape),
             self.state_spec)
-        s = init_state(cfg, prng.seed_key(0), node_state)
+        from ..core.extension import build_ext_state
+        s = init_state(cfg, prng.seed_key(0), node_state,
+                       build_ext_state(cfg, self.extensions))
 
         C, Pw = cfg.event_capacity, cfg.payload_words
         deadline = np.full(C, T.T_INF, np.int32)
@@ -173,6 +178,68 @@ class Runtime:
         MADSIM_TEST_SEED repro analog)."""
         state = self.init_single(seed)
         return self.run(state, max_steps, chunk, collect_events)
+
+    # ------------------------------------------------------------------
+    # Imperative supervisor surface (Handle::kill/... runtime/mod.rs:200-256)
+    # for host-driven scenarios: injects a supervisor op into every
+    # trajectory's event table at its current virtual time; it dispatches on
+    # the next step. Prefer Scenario for anything that can be pre-scripted
+    # (it stays entirely on-device); this is for interactive control between
+    # run() chunks.
+    @functools.cached_property
+    def _inject(self):
+        from ..core import types as Ty
+        from ..ops.select import first_k_free
+
+        def one(state, op, node, src, payload):
+            free = state.t_kind == Ty.EV_FREE
+            slots, ok = first_k_free(free, 1)
+            slot, ok = slots[0], ok[0]
+            w = ok & ~state.halted
+            return state.replace(
+                t_deadline=state.t_deadline.at[slot].set(
+                    jnp.where(w, state.now, state.t_deadline[slot])),
+                t_kind=state.t_kind.at[slot].set(
+                    jnp.where(w, Ty.EV_SUPER, state.t_kind[slot])),
+                t_node=state.t_node.at[slot].set(
+                    jnp.where(w, node, state.t_node[slot])),
+                t_src=state.t_src.at[slot].set(
+                    jnp.where(w, src, state.t_src[slot])),
+                t_tag=state.t_tag.at[slot].set(
+                    jnp.where(w, op, state.t_tag[slot])),
+                t_payload=state.t_payload.at[slot].set(
+                    jnp.where(w, payload, state.t_payload[slot])),
+                oops=state.oops | jnp.where(~ok & ~state.halted,
+                                            Ty.OOPS_EVENT_OVERFLOW, 0),
+            )
+
+        return jax.jit(jax.vmap(one, in_axes=(0, None, None, None, None)))
+
+    def inject(self, state: SimState, op: int, node: int = 0, src: int = 0,
+               payload=()) -> SimState:
+        pw = np.zeros(self.cfg.payload_words, np.int32)
+        pw[:len(payload)] = payload
+        return self._inject(state, jnp.asarray(op, jnp.int32),
+                            jnp.asarray(node, jnp.int32),
+                            jnp.asarray(src, jnp.int32), jnp.asarray(pw))
+
+    def kill(self, state, node):
+        return self.inject(state, T.OP_KILL, node)
+
+    def restart(self, state, node):
+        return self.inject(state, T.OP_RESTART, node)
+
+    def pause(self, state, node):
+        return self.inject(state, T.OP_PAUSE, node)
+
+    def resume(self, state, node):
+        return self.inject(state, T.OP_RESUME, node)
+
+    def clog_link(self, state, src, dst):
+        return self.inject(state, T.OP_CLOG_LINK, dst, src)
+
+    def heal(self, state):
+        return self.inject(state, T.OP_HEAL)
 
     # ------------------------------------------------------------------
     def fingerprints(self, state: SimState) -> np.ndarray:

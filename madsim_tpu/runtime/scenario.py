@@ -165,6 +165,14 @@ class _At:
     def set_latency(self, lo: int, hi: int):
         return self._add(T.OP_SET_LATENCY, payload=(int(lo), int(hi)))
 
+    # -- extension custom ops (plugin framework analog) --------------------
+    def custom(self, op: int, node=0, src=0, payload=()):
+        """Schedule an extension's supervisor op (op >= extension.OP_USER);
+        dispatched to every registered Extension.on_op at fire time."""
+        from ..core.extension import OP_USER
+        assert op >= OP_USER, f"custom ops must be >= {OP_USER}"
+        return self._add(op, node, src, payload)
+
     # -- end of simulation -------------------------------------------------
     def halt(self):
         return self._add(T.OP_HALT)

@@ -72,3 +72,34 @@ class TestInterval:
         # on-time tick: all behaviors agree
         assert int(next_tick(100, 100, 50, BURST)) == 150
         assert int(next_tick(100, 100, 50, SKIP)) == 150
+
+
+class TestImperativeSupervisor:
+    def test_host_driven_kill_restart(self):
+        # Handle-style imperative control between run() chunks
+        rt = _rt(target=500)  # big enough that 256 steps cannot finish
+        state = rt.init_batch(np.arange(8))
+        state, _ = rt.run(state, 256, chunk=256)
+        assert not np.asarray(state.halted).any()
+        state = rt.kill(state, 1)
+        state = rt.kill(state, 2)
+        state, _ = rt.run(state, 256, chunk=256)
+        assert not np.asarray(state.alive)[:, 1].any()
+        state = rt.restart(state, 1)
+        state = rt.restart(state, 2)
+        state, _ = rt.run(state, 20_000, chunk=1024)
+        assert bool(state.halted.all())
+        assert not bool(state.crashed.any())
+        assert np.asarray(state.alive)[:, 1:].all()
+
+
+class TestStats:
+    def test_summarize(self):
+        from madsim_tpu.parallel.stats import summarize
+        rt = _rt(target=5)
+        state, _ = rt.run(rt.init_batch(np.arange(16)), 4000)
+        s = summarize(rt, state)
+        assert s["batch"] == 16 and s["halted"] == 16 and s["crashed"] == 0
+        assert s["distinct_outcomes"] >= 12      # schedule diversity
+        assert s["msgs_sent"] > 0 and s["events_total"] > 0
+        assert s["first_crash_seed"] is None
