@@ -92,6 +92,46 @@ class NetConfig:
     send_latency_min: int = 1 * TICKS_PER_MS
     send_latency_max: int = 10 * TICKS_PER_MS
 
+    def __post_init__(self):
+        assert 0.0 <= self.packet_loss_rate <= 1.0, \
+            f"packet_loss_rate {self.packet_loss_rate} not in [0, 1]"
+        assert 0 <= self.send_latency_min <= self.send_latency_max, \
+            (f"inverted latency range {self.send_latency_min}.."
+             f"{self.send_latency_max}")
+
+    @staticmethod
+    def from_toml(text: str) -> "NetConfig":
+        """Parse the reference's TOML config shape (config.rs:35-66):
+
+            [net]
+            packet_loss_rate = 0.1
+            send_latency = "1ms..10ms"   # or send_latency_min/max in ticks
+        """
+        import tomllib
+
+        data = tomllib.loads(text).get("net", {})
+        kw = {}
+        if "packet_loss_rate" in data:
+            kw["packet_loss_rate"] = float(data["packet_loss_rate"])
+        if "send_latency" in data:  # "Xms..Yms" range string
+            lo, hi = str(data["send_latency"]).split("..")
+            kw["send_latency_min"] = _parse_dur(lo)
+            kw["send_latency_max"] = _parse_dur(hi)
+        if "send_latency_min" in data:
+            kw["send_latency_min"] = int(data["send_latency_min"])
+        if "send_latency_max" in data:
+            kw["send_latency_max"] = int(data["send_latency_max"])
+        return NetConfig(**kw)
+
+
+def _parse_dur(s: str) -> int:
+    """'5ms' / '10us' / '1s' -> ticks."""
+    s = s.strip()
+    for suffix, mul in (("us", 1), ("ms", TICKS_PER_MS), ("s", TICKS_PER_SEC)):
+        if s.endswith(suffix):
+            return int(float(s[:-len(suffix)]) * mul)
+    return int(s)
+
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:

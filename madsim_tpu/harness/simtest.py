@@ -43,23 +43,62 @@ def _env_int(name, default):
     return int(v) if v else default
 
 
-def run_seeds(rt: Runtime, seeds, max_steps: int, chunk: int = 512):
+def apply_net_override(state, net):
+    """Apply a NetConfig onto a (batched) state's DYNAMIC network knobs —
+    loss and latency live in state, so MADSIM_TEST_CONFIG can reshape the
+    fault model without recompiling (the TOML-injection contract of
+    macros lib.rs:146-151)."""
+    import jax.numpy as jnp
+    if net is None:
+        return state
+    return state.replace(
+        loss=jnp.full_like(state.loss, net.packet_loss_rate),
+        lat_lo=jnp.full_like(state.lat_lo, net.send_latency_min),
+        lat_hi=jnp.full_like(state.lat_hi, net.send_latency_max))
+
+
+def env_net_override():
+    """NetConfig from the MADSIM_TEST_CONFIG env var (a TOML file path),
+    or None."""
+    path = os.environ.get("MADSIM_TEST_CONFIG")
+    if not path:
+        return None
+    with open(path) as f:
+        return T.NetConfig.from_toml(f.read())
+
+
+def effective_config_hash(rt: Runtime, net_override=None) -> str:
+    """Repro hash covering BOTH the static config and any runtime net
+    override — the printed hash must identify the config that actually ran
+    (the config.rs:27-31 contract)."""
+    h = rt.cfg.hash()
+    if net_override is None:
+        return h
+    import hashlib
+    blob = f"{h}|{net_override}".encode()
+    return hashlib.sha256(blob).hexdigest()[:8]
+
+
+def run_seeds(rt: Runtime, seeds, max_steps: int, chunk: int = 512,
+              net_override=None):
     """Run a seed batch to completion; raise SimFailure on the first crashed
     seed (lowest index). Returns the final batched state."""
-    state, _ = rt.run(rt.init_batch(np.asarray(seeds, np.uint32)), max_steps,
-                      chunk=chunk)
+    init = apply_net_override(rt.init_batch(np.asarray(seeds, np.uint32)),
+                              net_override)
+    cfg_hash = effective_config_hash(rt, net_override)
+    state, _ = rt.run(init, max_steps, chunk=chunk)
     crashed = np.asarray(state.crashed)
     if crashed.any():
         i = int(np.argmax(crashed))
         raise SimFailure(
             seeds[i], np.asarray(state.crash_code)[i],
-            np.asarray(state.crash_node)[i], rt.cfg.hash(),
+            np.asarray(state.crash_node)[i], cfg_hash,
             msg=f"({int(crashed.sum())}/{len(seeds)} seeds crashed)")
     oops = np.asarray(state.oops)
     if (oops != 0).any():
         i = int(np.argmax(oops != 0))
         raise SimFailure(
-            seeds[i], 0, -1, rt.cfg.hash(),
+            seeds[i], 0, -1, cfg_hash,
             msg=f"capacity overflow (oops bits {int(oops[i])}) — raise "
                 f"event_capacity")
     return state
@@ -90,14 +129,18 @@ def simtest(num_seeds: int = 16, max_steps: int = 20_000,
             out = fn(*args, **kwargs)
             rt, check_fn = out if isinstance(out, tuple) else (out, None)
             seeds = np.arange(base, base + n, dtype=np.uint32)
-            state = run_seeds(rt, seeds, max_steps, chunk)
+            override = env_net_override()
+            state = run_seeds(rt, seeds, max_steps, chunk,
+                              net_override=override)
             if check_fn is not None:
                 check_fn(state)
             if check_determinism or os.environ.get(
                     "MADSIM_TEST_CHECK_DETERMINISM"):
-                assert rt.check_determinism(base, max_steps), (
+                assert rt.check_determinism(base, max_steps,
+                                            net_override=override), (
                     f"nondeterminism detected for seed {base} "
-                    f"(MADSIM_CONFIG_HASH={rt.cfg.hash()})")
+                    f"(MADSIM_CONFIG_HASH="
+                    f"{effective_config_hash(rt, override)})")
             return state
         return wrapper
     return deco

@@ -171,6 +171,56 @@ class Runtime:
                 lambda *xs: np.concatenate(xs, axis=0), *events)
         return state, events
 
+    def run_compacting(self, state: SimState, max_steps: int,
+                       chunk: int = 512, compact_when: float = 0.5,
+                       min_batch: int = 256):
+        """Like run(), but with divergent-trajectory early-exit compaction
+        (BASELINE.md config 4): when more than `compact_when` of the lanes
+        have halted, stash them host-side and re-pack the survivors into a
+        smaller batch (padded to a power of two so at most log2(B) distinct
+        XLA programs compile). With long-tailed workloads most lanes finish
+        early; without compaction they occupy device lanes doing nothing.
+
+        Returns the full-batch final state in the ORIGINAL lane order.
+        """
+        runner = self._run_chunk[False]
+        B = int(np.asarray(state.halted).shape[0])
+        orig_idx = np.arange(B)
+        stash: list[tuple[np.ndarray, Any]] = []  # (orig indices, host copy)
+        done = 0
+        while done < max_steps:
+            state, _ = runner(state, chunk)
+            done += chunk
+            halted = np.asarray(state.halted)
+            n = halted.shape[0]
+            if halted.all():
+                break
+            live = int((~halted).sum())
+            if n > min_batch and live / n < (1 - compact_when):
+                # pad the live set with halted lanes up to a power of two
+                # (frozen lanes are ~free); stash the rest host-side
+                target = max(min_batch, 1 << int(np.ceil(np.log2(live))))
+                if target < n:
+                    live_idx = np.nonzero(~halted)[0]
+                    pad_idx = np.nonzero(halted)[0][:target - live]
+                    keep = np.concatenate([live_idx, pad_idx])
+                    drop = np.setdiff1d(np.arange(n), keep)
+                    host = jax.tree.map(np.asarray, state)
+                    stash.append((orig_idx[drop],
+                                  jax.tree.map(lambda a: a[drop], host)))
+                    state = jax.tree.map(lambda a: jnp.asarray(a[keep]), host)
+                    orig_idx = orig_idx[keep]
+        # merge: stashed lanes + final state, back in original order
+        final_host = jax.tree.map(np.asarray, state)
+        parts = stash + [(orig_idx, final_host)]
+        order = np.concatenate([p[0] for p in parts])
+        inv = np.argsort(order)
+
+        def merge(*leaves):
+            return jnp.asarray(np.concatenate(leaves, axis=0)[inv])
+
+        return jax.tree.map(merge, *[p[1] for p in parts])
+
     def run_single(self, seed: int, max_steps: int, chunk: int = 512,
                    collect_events: bool = True):
         """Debug path: one seed, optionally with the event trace — the
@@ -246,12 +296,19 @@ class Runtime:
         """uint32 fingerprint per trajectory (determinism checks)."""
         return np.asarray(jax.jit(jax.vmap(fingerprint))(state))
 
-    def check_determinism(self, seed: int, max_steps: int) -> bool:
+    def check_determinism(self, seed: int, max_steps: int,
+                          net_override=None) -> bool:
         """Run the same seed twice and bitwise-compare final state — the
         enable_determinism_check analog (runtime/mod.rs:144-187), but over
-        the full state rather than the RNG draw log."""
-        s1, _ = self.run(self.init_single(seed), max_steps,
-                         collect_events=False)
-        s2, _ = self.run(self.init_single(seed), max_steps,
-                         collect_events=False)
-        return bool((self.fingerprints(s1) == self.fingerprints(s2)).all())
+        the full state rather than the RNG draw log. `net_override` (a
+        NetConfig) is applied to both replays so the check validates the
+        same fault model the test actually ran."""
+        from ..harness.simtest import apply_net_override
+
+        def once():
+            s = apply_net_override(self.init_single(seed), net_override)
+            s, _ = self.run(s, max_steps, collect_events=False)
+            return s
+
+        return bool((self.fingerprints(once())
+                     == self.fingerprints(once())).all())

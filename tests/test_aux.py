@@ -103,3 +103,72 @@ class TestStats:
         assert s["distinct_outcomes"] >= 12      # schedule diversity
         assert s["msgs_sent"] > 0 and s["events_total"] > 0
         assert s["first_crash_seed"] is None
+
+
+class TestCompaction:
+    def test_compacting_run_matches_plain_run(self):
+        # long-tailed completion: trajectories halt at widely different
+        # event counts; compaction must not change ANY final state
+        rt = _rt(target=20)
+        seeds = np.arange(384)
+        plain, _ = rt.run(rt.init_batch(seeds), 6000, chunk=512)
+        compacted = rt.run_compacting(rt.init_batch(seeds), 6000,
+                                      chunk=512, min_batch=64)
+        assert bool(np.asarray(compacted.halted).all())
+        assert (rt.fingerprints(plain) == rt.fingerprints(compacted)).all()
+
+
+class TestSimtestHarness:
+    def test_simtest_decorator_and_env_knobs(self, monkeypatch, tmp_path):
+        from madsim_tpu import simtest
+
+        calls = {}
+
+        @simtest(num_seeds=4, max_steps=4000, seed=7)
+        def my_test():
+            rt = _rt(target=3)
+            def check(state):
+                calls["checked"] = int(np.asarray(state.halted).sum())
+            return rt, check
+
+        state = my_test()
+        assert calls["checked"] == 4
+
+        # env overrides: seed base, batch size, TOML net config
+        cfgf = tmp_path / "net.toml"
+        cfgf.write_text('[net]\npacket_loss_rate = 0.25\n'
+                        'send_latency = "2ms..8ms"\n')
+        monkeypatch.setenv("MADSIM_TEST_SEED", "100")
+        monkeypatch.setenv("MADSIM_TEST_NUM", "6")
+        monkeypatch.setenv("MADSIM_TEST_CONFIG", str(cfgf))
+        state = my_test()
+        assert np.asarray(state.halted).shape[0] == 6
+        assert float(np.asarray(state.loss)[0]) == 0.25
+        assert int(np.asarray(state.lat_lo)[0]) == 2000
+        assert int(np.asarray(state.lat_hi)[0]) == 8000
+        assert int(np.asarray(state.msg_dropped).sum()) > 0  # loss applied
+
+    def test_failure_reports_repro_seed(self):
+        from madsim_tpu import Program, simtest
+        from madsim_tpu.harness.simtest import SimFailure
+        import jax.numpy as jnp
+
+        class Bad(Program):
+            def init(self, ctx):
+                ctx.set_timer(ms(1), 1)
+
+            def on_timer(self, ctx, tag, payload):
+                ctx.crash_if(ctx.uniform() < 0.5, 99)
+                ctx.set_timer(ms(1), 1)
+
+        @simtest(num_seeds=8, max_steps=200, seed=0)
+        def failing():
+            cfg = SimConfig(n_nodes=1, time_limit=T.sec(1))
+            return Runtime(cfg, [Bad()], dict(x=jnp.asarray(0, jnp.int32)))
+
+        try:
+            failing()
+            assert False, "expected SimFailure"
+        except SimFailure as e:
+            assert "MADSIM_TEST_SEED=" in str(e)
+            assert e.code == 99

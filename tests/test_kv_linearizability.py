@@ -116,3 +116,33 @@ class TestKvFuzz:
         assert (opn >= 6).all()
         for h in extract_histories(state, 5, 2):
             assert check_kv_history(h)
+
+    def test_batch_vs_single_replay_equivalence(self):
+        # the replay-by-seed contract on the FULL stack: seed i inside a
+        # chaos batch reaches bit-identical state to seed i run alone
+        cfg = SimConfig(n_nodes=8, event_capacity=384, payload_words=12,
+                        time_limit=sec(4),
+                        net=NetConfig(packet_loss_rate=0.05))
+        rt = make_kv_runtime(n_raft=5, n_clients=3, n_keys=3, n_ops=6,
+                             log_capacity=48,
+                             scenario=_chaos_scenario(5), cfg=cfg)
+        batch, _ = rt.run(rt.init_batch(np.arange(12)), 40_000)
+        solo, _ = rt.run(rt.init_single(7), 40_000)
+        assert rt.fingerprints(batch)[7] == rt.fingerprints(solo)[0]
+
+    def test_checkpoint_mid_chaos_resumes_identically(self):
+        from madsim_tpu.runtime import checkpoint
+        cfg = SimConfig(n_nodes=8, event_capacity=384, payload_words=12,
+                        time_limit=sec(4),
+                        net=NetConfig(packet_loss_rate=0.05))
+        rt = make_kv_runtime(n_raft=5, n_clients=3, n_keys=3, n_ops=6,
+                             log_capacity=48,
+                             scenario=_chaos_scenario(5), cfg=cfg)
+        seeds = np.arange(8)
+        full, _ = rt.run(rt.init_batch(seeds), 40_000)
+        half, _ = rt.run(rt.init_batch(seeds), 2048, chunk=2048)
+        import tempfile, os
+        p = os.path.join(tempfile.mkdtemp(), "kv.npz")
+        checkpoint.save(p, half)
+        resumed, _ = rt.run(checkpoint.load(p, rt.init_batch(seeds)), 40_000)
+        assert (rt.fingerprints(full) == rt.fingerprints(resumed)).all()
