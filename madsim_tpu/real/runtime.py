@@ -45,7 +45,11 @@ class RealNode:
         self.alive = False
         self.paused = False
         self.parked: list = []         # events deferred while paused
-        self.transport = None
+        self.transport = None          # udp transport
+        self.server = None             # tcp server
+        self.conns: dict = {}          # tcp: dst -> StreamWriter
+        self.conn_locks: dict = {}     # tcp: dst -> Lock (one dial at a time)
+        self.tasks: list = []          # tcp reader tasks
         self.timers: list[asyncio.TimerHandle] = []
 
 
@@ -59,7 +63,9 @@ class RealRuntime:
 
     def __init__(self, cfg: T.SimConfig, programs: Sequence[Program],
                  state_spec: Any, node_prog=None, base_port: int = 19200,
-                 seed: int = 0):
+                 seed: int = 0, transport: str = "udp"):
+        assert transport in ("udp", "tcp")
+        self.transport = transport
         self.cfg = cfg
         self.programs = list(programs)
         self.node_prog = list(node_prog if node_prog is not None
@@ -73,6 +79,7 @@ class RealRuntime:
         self.crashed: list[tuple[int, int]] = []   # (node, code)
         self._halted = asyncio.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
+        self._bg: set = set()          # in-flight tcp send tasks
 
     # ------------------------------------------------------------------
     def _fresh_state(self):
@@ -92,11 +99,59 @@ class RealRuntime:
     async def start_node(self, i: int):
         n = self.nodes[i]
         loop = asyncio.get_running_loop()
-        n.transport, _ = await loop.create_datagram_endpoint(
-            lambda: _NodeProtocol(self, i),
-            local_addr=("127.0.0.1", self.base_port + i))
+        if self.transport == "udp":
+            n.transport, _ = await loop.create_datagram_endpoint(
+                lambda: _NodeProtocol(self, i),
+                local_addr=("127.0.0.1", self.base_port + i))
+        else:
+            # TCP backend: length-delimited frames over lazily-established
+            # per-peer connections — the shape of the reference's real TCP
+            # Endpoint (std/net/tcp.rs:69-151: connect-on-first-send, a
+            # reader task per connection feeding the mailbox)
+            n.server = await asyncio.start_server(
+                lambda r, w: self._tcp_reader(i, r, w),
+                "127.0.0.1", self.base_port + i)
         n.alive = True
         self._dispatch(i, "init")
+
+    async def _tcp_reader(self, node: int, reader, writer):
+        n = self.nodes[node]
+        task = asyncio.current_task()
+        n.tasks.append(task)
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = struct.unpack("<I", hdr)
+                data = await reader.readexactly(ln)
+                if self.nodes[node].alive:
+                    self._on_datagram(node, data)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+            if task in n.tasks:        # prune on normal close, not just kill
+                n.tasks.remove(task)
+
+    async def _tcp_send(self, src: int, dst: int, pkt: bytes):
+        n = self.nodes[src]
+        if not n.alive:                # killed after the send was queued
+            return
+        lock = n.conn_locks.setdefault(dst, asyncio.Lock())
+        try:
+            async with lock:           # one dial per peer at a time — no
+                w = n.conns.get(dst)   # duplicate-connection leak on
+                if w is None or w.is_closing():  # broadcast bursts
+                    _, w = await asyncio.open_connection(
+                        "127.0.0.1", self.base_port + dst)
+                    if not n.alive:    # killed while dialing
+                        w.close()
+                        return
+                    n.conns[dst] = w
+            w.write(struct.pack("<I", len(pkt)) + pkt)
+            await w.drain()
+        except (ConnectionError, OSError):
+            n.conns.pop(dst, None)  # peer down: datagram-like drop
 
     def kill(self, i: int):
         n = self.nodes[i]
@@ -109,6 +164,15 @@ class RealRuntime:
         if n.transport:
             n.transport.close()
             n.transport = None
+        if n.server:
+            n.server.close()
+            n.server = None
+        for w in n.conns.values():
+            w.close()
+        n.conns.clear()
+        for t in n.tasks:
+            t.cancel()
+        n.tasks.clear()
 
     async def restart(self, i: int):
         self.kill(i)
@@ -159,12 +223,20 @@ class RealRuntime:
             if not bool(e["m"]):
                 continue
             dst = int(e["dst"])
+            if not (0 <= dst < self.cfg.n_nodes) or not n.alive:
+                continue
             pkt = struct.pack(f"<ii{P}i", int(e["tag"]), n.id,
                               *np.asarray(e["payload"], np.int32))
-            if n.transport is not None and 0 <= dst < self.cfg.n_nodes:
-                # real send: straight to the peer's socket; latency, loss
-                # and reordering are whatever the real network does
-                n.transport.sendto(pkt, ("127.0.0.1", self.base_port + dst))
+            # real send: straight to the peer; latency, loss, and
+            # reordering are whatever the real network does
+            if self.transport == "udp":
+                if n.transport is not None:
+                    n.transport.sendto(pkt,
+                                       ("127.0.0.1", self.base_port + dst))
+            else:
+                task = self._loop.create_task(self._tcp_send(n.id, dst, pkt))
+                self._bg.add(task)
+                task.add_done_callback(self._bg.discard)
         for e in ctx._timers:
             if not bool(e["m"]):
                 continue
