@@ -25,14 +25,16 @@ from .core.types import (
     ms,
     sec,
 )
-from .harness.simtest import simtest
+from .core.extension import Extension
+from .harness.simtest import SimFailure, run_seeds, simtest
 from .runtime.runtime import Runtime
 from .runtime.scenario import Scenario
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "Ctx", "Program", "SimState", "SimConfig", "NetConfig", "Runtime",
-    "Scenario", "simtest", "ms", "sec", "NODE_RANDOM", "EV_MSG", "EV_TIMER",
-    "EV_SUPER", "CRASH_DEADLOCK", "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
+    "Ctx", "Program", "Extension", "SimState", "SimConfig", "NetConfig",
+    "Runtime", "Scenario", "simtest", "run_seeds", "SimFailure", "ms", "sec",
+    "NODE_RANDOM", "EV_MSG", "EV_TIMER", "EV_SUPER", "CRASH_DEADLOCK",
+    "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
 ]

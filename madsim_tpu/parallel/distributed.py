@@ -11,10 +11,10 @@ Single-controller-per-host SPMD: every host runs the same program on its
 own slice of the seed batch; `host_seed_slice` carves the global seed range
 so lanes land on their local chips.
 
-NOTE: validated on a single host with a virtual device mesh (the CI
-environment has one chip); the multi-host path follows the standard
-jax.distributed recipe and is exercised by dryrun_multichip's sharded
-compile. Flagged in PARITY.md as untested on real multi-host hardware.
+Validated two ways: the sharded compile path via dryrun_multichip's virtual
+mesh, and a real two-process run over a loopback coordinator
+(tests/test_distributed.py). Real multi-HOST hardware has not been
+available; the recipe is the standard jax.distributed one.
 """
 
 from __future__ import annotations
@@ -25,17 +25,25 @@ import numpy as np
 from .mesh import seed_mesh, shard_batch
 
 
+_initialized = False
+
+
 def init_distributed(coordinator_address: str | None = None,
                      num_processes: int | None = None,
                      process_id: int | None = None) -> None:
-    """Initialize the multi-host runtime (idempotent, no-op when
-    single-process and no coordinator is configured). Call before any jax
-    op on every host, mirroring jax.distributed.initialize's contract."""
+    """Initialize the multi-host runtime (idempotent within a process;
+    no-op when single-process and no coordinator is configured). Must run
+    before anything initializes the XLA backend — including importing
+    libraries that touch jax.devices() (flax does)."""
+    global _initialized
     if coordinator_address is None and num_processes is None:
         return  # single-process: nothing to do
+    if _initialized:
+        return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+    _initialized = True
 
 
 def global_seed_mesh():
@@ -44,14 +52,17 @@ def global_seed_mesh():
 
 
 def host_seed_slice(total_seeds: int, base_seed: int = 0) -> np.ndarray:
-    """This host's contiguous slice of the global seed range, sized by its
-    share of addressable devices (even split; remainder to low ranks)."""
+    """This process's contiguous slice of the global seed range. The global
+    batch must divide evenly across processes (global-shard assembly
+    requires equal local shards); round the sweep size up rather than
+    passing a ragged total."""
     n_proc = jax.process_count()
     pid = jax.process_index()
-    per, rem = divmod(total_seeds, n_proc)
-    start = pid * per + min(pid, rem)
-    count = per + (1 if pid < rem else 0)
-    return np.arange(base_seed + start, base_seed + start + count,
+    assert total_seeds % n_proc == 0, (
+        f"total_seeds {total_seeds} must divide evenly across {n_proc} "
+        f"processes — pad the sweep to a multiple")
+    per = total_seeds // n_proc
+    return np.arange(base_seed + pid * per, base_seed + (pid + 1) * per,
                      dtype=np.uint32)
 
 

@@ -18,6 +18,13 @@ _KIND = {T.EV_MSG: "MSG", T.EV_TIMER: "TIMER", T.EV_SUPER: "SUPER"}
 _OP = {v: k[3:] for k, v in vars(T).items() if k.startswith("OP_")}
 
 
+def _columns(events: dict, b: int):
+    """One seed's event columns + the indices of fired steps."""
+    cols = {k: np.asarray(events[k])[:, b]
+            for k in ("fired", "now", "kind", "node", "src", "tag")}
+    return cols, np.nonzero(cols["fired"])[0]
+
+
 def format_trace(events: dict, b: int = 0, time_start: int = 0,
                  node_names=None, limit: int | None = None) -> list[str]:
     """Render trajectory b's event stream as text lines.
@@ -26,14 +33,11 @@ def format_trace(events: dict, b: int = 0, time_start: int = 0,
     arrays shaped [steps, batch, ...]. time_start filters records before a
     virtual instant (the MADSIM_LOG_TIME_START analog).
     """
-    fired = np.asarray(events["fired"])[:, b]
-    now = np.asarray(events["now"])[:, b]
-    kind = np.asarray(events["kind"])[:, b]
-    node = np.asarray(events["node"])[:, b]
-    src = np.asarray(events["src"])[:, b]
-    tag = np.asarray(events["tag"])[:, b]
+    cols, idx = _columns(events, b)
+    now, kind = cols["now"], cols["kind"]
+    node, src, tag = cols["node"], cols["src"], cols["tag"]
     lines = []
-    for i in np.nonzero(fired)[0]:
+    for i in idx:
         if now[i] < time_start:
             continue
         t_ms = now[i] / T.TICKS_PER_MS
@@ -55,3 +59,36 @@ def format_trace(events: dict, b: int = 0, time_start: int = 0,
 def print_trace(events: dict, b: int = 0, **kw) -> None:
     for line in format_trace(events, b, **kw):
         print(line)
+
+
+def export_chrome_trace(events: dict, path: str, b: int = 0,
+                        node_names=None) -> int:
+    """Write one seed's event stream as a Chrome/Perfetto trace JSON
+    (open in chrome://tracing or ui.perfetto.dev): one row per node,
+    instant events at virtual-time microseconds. Returns event count.
+
+    The visual-timeline upgrade over the reference's text logger — the
+    virtual clock maps directly onto the trace's microsecond axis.
+    """
+    import json
+
+    cols, idx = _columns(events, b)
+    now, kind = cols["now"], cols["kind"]
+    node, src, tag = cols["node"], cols["src"], cols["tag"]
+    out = []
+    for i in idx:
+        k = _KIND.get(int(kind[i]), "?")
+        name = (f"{k}:{_OP.get(int(tag[i]), tag[i])}" if kind[i] == T.EV_SUPER
+                else f"{k}:tag{tag[i]}")
+        out.append(dict(
+            name=name, ph="i", s="t",
+            ts=int(now[i]), pid=0, tid=int(node[i]),
+            args=dict(src=int(src[i]), tag=int(tag[i])),
+        ))
+    meta = [dict(name="thread_name", ph="M", pid=0, tid=t,
+                 args=dict(name=(node_names[t] if node_names is not None
+                                 else f"node{t}")))
+            for t in sorted(set(node[idx].tolist()))]
+    with open(path, "w") as f:
+        json.dump(dict(traceEvents=meta + out, displayTimeUnit="ms"), f)
+    return len(out)

@@ -172,3 +172,22 @@ class TestSimtestHarness:
         except SimFailure as e:
             assert "MADSIM_TEST_SEED=" in str(e)
             assert e.code == 99
+
+
+class TestChromeTrace:
+    def test_export_chrome_trace(self, tmp_path):
+        import json
+        from madsim_tpu.runtime.trace import export_chrome_trace
+        rt = _rt(target=3)
+        _, events = rt.run_single(5, 2000, collect_events=True)
+        p = str(tmp_path / "trace.json")
+        n = export_chrome_trace(events, p)
+        assert n > 10
+        doc = json.load(open(p))
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        names = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert len(evs) == n and len(names) >= 3
+        assert any("SUPER:INIT" in e["name"] for e in evs)
+        # timestamps are virtual microseconds, monotone nondecreasing
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)
