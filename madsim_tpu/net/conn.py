@@ -1,0 +1,100 @@
+"""Connection management over the stream fabric — the TcpListener/
+TcpStream surface (sim/net/tcp/{listener,stream}.rs).
+
+net/stream.py supplies the byte-pipe semantics (ordered, reliable,
+windowed); this layer adds the connection lifecycle the reference models:
+handshake before data flows (stream.rs:93 sleeps 3x latency for the
+handshake), connection state per peer, refusal when nobody listens, and
+reset on peer death (stream.rs:162-209: reads EOF / writes fail once the
+peer socket is gone).
+
+State machine per (node, peer): CLOSED -> SYN_SENT -> ESTABLISHED on the
+initiator; CLOSED -> ESTABLISHED on the listener when a SYN arrives while
+listening. A SYN to a non-listening node draws RST. Death detection is the
+application's concern (as in the reference, where only a *reset* — not a
+kill alone — tears streams down).
+
+All helpers are masked/traceable; see tests/test_conn.py for the idiom.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.api import Ctx
+
+TAG_SYN = (1 << 21)
+TAG_SYN_ACK = (1 << 21) + 1
+TAG_RST = (1 << 21) + 2
+
+CLOSED, SYN_SENT, ESTABLISHED = 0, 1, 2
+
+
+def conn_state(n_nodes: int):
+    return dict(
+        cn_state=jnp.zeros((n_nodes,), jnp.int32),   # per-peer conn state
+        cn_listen=jnp.asarray(0, jnp.int32),         # listening flag
+    )
+
+
+def listen(ctx: Ctx, st, *, when=True):
+    """Start accepting connections (TcpListener::bind analog)."""
+    st["cn_listen"] = jnp.where(when, 1, st["cn_listen"])
+
+
+def connect(ctx: Ctx, st, dst, *, when=True):
+    """Initiate a handshake (TcpStream::connect). Completion is observed
+    via is_established once the SYN-ACK returns; pair with a retry timer
+    for lossy networks."""
+    dst = jnp.asarray(dst, jnp.int32)
+    # dialing is idempotent from SYN_SENT so a retry timer can re-send a
+    # lost SYN (the reference's connect retries inside try_send)
+    ok = jnp.asarray(when) & ((st["cn_state"][dst] == CLOSED)
+                              | (st["cn_state"][dst] == SYN_SENT))
+    st["cn_state"] = st["cn_state"].at[dst].set(
+        jnp.where(ok, SYN_SENT, st["cn_state"][dst]))
+    ctx.send(dst, TAG_SYN, [0], when=ok)
+    return ok
+
+
+def is_established(st, peer):
+    return st["cn_state"][jnp.asarray(peer, jnp.int32)] == ESTABLISHED
+
+
+def on_message(ctx: Ctx, st, src, tag):
+    """Feed connection-control messages through the state machine. Returns
+    (accepted, established, reset) masks for this event. Call before
+    stream.on_message; data for CLOSED peers should be ignored by the app.
+    """
+    src = jnp.asarray(src, jnp.int32)
+
+    # listener side: SYN while listening -> ESTABLISHED + SYN-ACK;
+    # SYN while not listening -> RST (connection refused)
+    is_syn = tag == TAG_SYN
+    accept = is_syn & (st["cn_listen"] == 1)
+    refuse = is_syn & (st["cn_listen"] != 1)
+    st["cn_state"] = st["cn_state"].at[src].set(
+        jnp.where(accept, ESTABLISHED, st["cn_state"][src]))
+    ctx.send(src, TAG_SYN_ACK, [0], when=accept)
+    ctx.send(src, TAG_RST, [0], when=refuse)
+
+    # initiator side: SYN-ACK completes the handshake
+    is_sa = (tag == TAG_SYN_ACK) & (st["cn_state"][src] == SYN_SENT)
+    st["cn_state"] = st["cn_state"].at[src].set(
+        jnp.where(is_sa, ESTABLISHED, st["cn_state"][src]))
+
+    # RST tears the connection down (ConnectionReset)
+    is_rst = tag == TAG_RST
+    st["cn_state"] = st["cn_state"].at[src].set(
+        jnp.where(is_rst, CLOSED, st["cn_state"][src]))
+
+    return accept, is_sa, is_rst
+
+
+def reset(ctx: Ctx, st, peer, *, when=True):
+    """Abort a connection and notify the peer (the reset-on-close path)."""
+    peer = jnp.asarray(peer, jnp.int32)
+    w = jnp.asarray(when) & (st["cn_state"][peer] != CLOSED)
+    st["cn_state"] = st["cn_state"].at[peer].set(
+        jnp.where(w, CLOSED, st["cn_state"][peer]))
+    ctx.send(peer, TAG_RST, [0], when=w)

@@ -1,0 +1,105 @@
+"""Connection lifecycle over the simulated network: handshake, refusal,
+reset, and data-over-connection (the tcp listener/stream test shapes,
+tcp/mod.rs:57-218)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu import Program, Runtime, Scenario, SimConfig, NetConfig, ms, sec
+from madsim_tpu.harness.simtest import run_seeds
+from madsim_tpu.net import conn, stream
+
+T_CONNECT, T_PUMP, T_RETX = 1, 2, 3
+K = 12
+W = 4
+
+
+def spec(n):
+    z = jnp.asarray(0, jnp.int32)
+    return dict(pushed=z, got=z, refused=z, established=z,
+                rx_log=jnp.full((K,), -1, jnp.int32),
+                **conn.conn_state(n), **stream.stream_state(n, window=W))
+
+
+class Client(Program):
+    """Node 0 connects to node 1, then streams 0..K-1 over the connection.
+    Node 2 (if present) tries to connect to a NON-listening node 0 and must
+    be refused."""
+
+    def init(self, ctx):
+        st = dict(ctx.state)
+        conn.listen(ctx, st, when=ctx.node == 1)     # only node 1 listens
+        ctx.set_timer(ms(1), T_CONNECT,
+                      when=(ctx.node == 0) | (ctx.node == 2))
+        ctx.set_timer(ms(15), T_RETX, when=ctx.node == 0)
+        ctx.state = st
+
+    def on_timer(self, ctx, tag, payload):
+        st = dict(ctx.state)
+        # node 0 dials node 1; node 2 dials node 0 (refused); retry dialing
+        want = jnp.where(ctx.node == 0, 1, 0)
+        dialing = (tag == T_CONNECT) & ((ctx.node == 0) | (ctx.node == 2))
+        conn.connect(ctx, st, want, when=dialing)
+        ctx.set_timer(ms(20), T_CONNECT,
+                      when=dialing & ~conn.is_established(st, want)
+                      & (st["refused"] == 0))
+
+        # pump data once established (sender = node 0 only)
+        est = conn.is_established(st, 1) & (ctx.node == 0)
+        is_pump = ((tag == T_PUMP) | (tag == T_CONNECT)) & est
+        for _ in range(2):
+            ok = stream.send(ctx, st, 1, st["pushed"],
+                             when=is_pump & (st["pushed"] < K))
+            st["pushed"] = st["pushed"] + ok
+        ctx.set_timer(ms(5), T_PUMP, when=is_pump & (st["pushed"] < K))
+        is_retx = (tag == T_RETX) & (ctx.node == 0)
+        stream.retransmit(ctx, st, 1, when=is_retx & est)
+        ctx.set_timer(ms(15), T_RETX, when=is_retx)
+        ctx.state = st
+
+    def on_message(self, ctx, src, tag, payload):
+        st = dict(ctx.state)
+        accepted, established, was_rst = conn.on_message(ctx, st, src, tag)
+        st["established"] = st["established"] + established
+        st["refused"] = st["refused"] + (was_rst & (ctx.node == 2))
+
+        # only consume data over an ESTABLISHED connection
+        vals, mask = stream.on_message(ctx, st, src, tag, payload)
+        for i in range(W):
+            idx = jnp.clip(st["got"], 0, K - 1)
+            take = (mask[i] & (ctx.node == 1) & (st["got"] < K)
+                    & (st["cn_state"][src] == conn.ESTABLISHED))
+            st["rx_log"] = st["rx_log"].at[idx].set(
+                jnp.where(take, vals[i], st["rx_log"][idx]))
+            st["got"] = st["got"] + take
+        ctx.halt_if((ctx.node == 1) & (st["got"] >= K))
+        ctx.state = st
+
+
+class TestConn:
+    def _run(self, n=3, loss=0.0, seeds=8):
+        cfg = SimConfig(n_nodes=n, event_capacity=128, time_limit=sec(20),
+                        net=NetConfig(packet_loss_rate=loss,
+                                      send_latency_min=ms(1),
+                                      send_latency_max=ms(10)))
+        rt = Runtime(cfg, [Client()], spec(n))
+        return run_seeds(rt, np.arange(seeds), max_steps=40_000)
+
+    def test_handshake_then_ordered_data(self):
+        state = self._run()
+        logs = np.asarray(state.node_state["rx_log"])[:, 1]
+        assert (logs == np.arange(K)).all()
+        # handshake completed exactly once on the initiator
+        assert (np.asarray(state.node_state["established"])[:, 0] == 1).all()
+
+    def test_connect_to_non_listener_refused(self):
+        state = self._run()
+        refused = np.asarray(state.node_state["refused"])[:, 2]
+        assert (refused >= 1).all()                  # node 2 got RST
+        cn = np.asarray(state.node_state["cn_state"])
+        assert (cn[:, 2, 0] == conn.CLOSED).all()    # and stays closed
+
+    def test_handshake_survives_loss(self):
+        state = self._run(loss=0.25)
+        logs = np.asarray(state.node_state["rx_log"])[:, 1]
+        assert (logs == np.arange(K)).all()

@@ -1,0 +1,97 @@
+"""Engine edge cases: degenerate configs and supervisor-op interplay
+(the awkward corners the reference covers in its per-module inline tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu import (Program, Runtime, Scenario, SimConfig, NetConfig,
+                        ms, sec)
+from madsim_tpu.models.pingpong import PingPong, state_spec
+
+
+class SelfPinger(Program):
+    """Sends to ITSELF — loopback messages must deliver (localhost works
+    in the reference too)."""
+
+    def init(self, ctx):
+        ctx.set_timer(0, 1)
+
+    def on_timer(self, ctx, tag, payload):
+        ctx.send(ctx.node, 7, [41], when=ctx.state["got"] < 5)
+
+    def on_message(self, ctx, src, tag, payload):
+        st = dict(ctx.state)
+        hit = (tag == 7) & (src == ctx.node) & (payload[0] == 41)
+        st["got"] = st["got"] + hit
+        ctx.send(ctx.node, 7, [41], when=hit & (st["got"] < 5))
+        ctx.halt_if(st["got"] >= 5)
+        ctx.state = st
+
+
+class TestEdges:
+    def test_send_to_self(self):
+        rt = Runtime(SimConfig(n_nodes=1, time_limit=sec(5)),
+                     [SelfPinger()], dict(got=jnp.asarray(0, jnp.int32)))
+        state, _ = rt.run(rt.init_batch(np.arange(4)), 2000)
+        assert bool(state.halted.all()) and not bool(state.crashed.any())
+        assert (np.asarray(state.node_state["got"])[:, 0] == 5).all()
+
+    def test_total_loss_no_progress_no_deadlock(self):
+        # loss=1.0: nothing delivers, retry timers keep the world alive,
+        # the scenario HALT ends the run cleanly
+        cfg = SimConfig(n_nodes=3, time_limit=sec(1),
+                        net=NetConfig(packet_loss_rate=1.0))
+        rt = Runtime(cfg, [PingPong(3, target=5)], state_spec())
+        state, _ = rt.run(rt.init_batch(np.arange(4)), 20_000)
+        assert bool(state.halted.all()) and not bool(state.crashed.any())
+        assert (np.asarray(state.node_state["acked"])[:, 0] == 0).all()
+        assert int(np.asarray(state.msg_dropped).sum()) > 0
+
+    def test_zero_latency_network(self):
+        cfg = SimConfig(n_nodes=3, time_limit=sec(5),
+                        net=NetConfig(send_latency_min=0,
+                                      send_latency_max=0))
+        rt = Runtime(cfg, [PingPong(3, target=10)], state_spec())
+        state, _ = rt.run(rt.init_batch(np.arange(8)), 8000)
+        assert bool(state.halted.all()) and not bool(state.crashed.any())
+        assert rt.check_determinism(3, 4000)
+
+    def test_redundant_supervisor_ops_are_noops(self):
+        # kill a dead node, resume a never-paused node, restart an alive
+        # node (= reboot), pause a dead node: nothing crashes or wedges
+        sc = Scenario()
+        sc.at(ms(10)).kill(1)
+        sc.at(ms(20)).kill(1)          # kill dead
+        sc.at(ms(30)).resume(2)        # resume non-paused
+        sc.at(ms(40)).pause(1)         # pause dead (parked forever = fine)
+        sc.at(ms(50)).restart(0)       # reboot alive pinger
+        sc.at(ms(60)).restart(1)       # genuine restart
+        rt = Runtime(SimConfig(n_nodes=3, time_limit=sec(30)),
+                     [PingPong(3, target=10)], state_spec(), scenario=sc)
+        state, _ = rt.run(rt.init_batch(np.arange(8)), 20_000)
+        assert bool(state.halted.all()) and not bool(state.crashed.any())
+        # note: restart clears the pause flag (kill/boot reset semantics)
+        assert not np.asarray(state.paused).any()
+
+    def test_kill_clears_pause_clog_survives_restart(self):
+        # pause -> kill: pause flag cleared (task.rs kill semantics);
+        # clog_node is NETWORK state, not process state: it survives
+        # kill/restart (NetSim reset clears sockets, not clogs)
+        sc = Scenario()
+        sc.at(ms(5)).pause(1)
+        sc.at(ms(10)).clog_node(1)
+        sc.at(ms(15)).kill(1)
+        sc.at(ms(20)).restart(1)
+        rt = Runtime(SimConfig(n_nodes=3, time_limit=sec(1)),
+                     [PingPong(3, target=500)], state_spec(), scenario=sc)
+        state, _ = rt.run(rt.init_single(0), 20_000)
+        assert not bool(np.asarray(state.paused)[0, 1])
+        assert bool(np.asarray(state.clog_node)[0, 1])   # still clogged
+        assert bool(np.asarray(state.alive)[0, 1])
+
+    def test_single_node_cluster(self):
+        rt = Runtime(SimConfig(n_nodes=1, time_limit=sec(2)),
+                     [PingPong(1, target=3)],
+                     state_spec())
+        state, _ = rt.run(rt.init_single(0), 4000)
+        assert bool(state.halted.all()) and not bool(state.crashed.any())
