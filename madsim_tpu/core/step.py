@@ -273,12 +273,18 @@ def make_step(
                 t_payload=put(s.t_payload, em_payload),
             )
 
+        # oops/steps are correctness-bearing and always tracked; the stat
+        # counters honor cfg.collect_stats (Stat is optional in the
+        # reference too — NetSim::stat is a query, not a requirement)
+        if cfg.collect_stats:
+            s = s.replace(
+                msg_sent=s.msg_sent + sent,
+                msg_delivered=s.msg_delivered + is_msg.astype(jnp.int32),
+                msg_dropped=s.msg_dropped + delivered_drop
+                + dropped.astype(jnp.int32),
+                ev_peak=jnp.maximum(s.ev_peak, high_water),
+            )
         s = s.replace(
-            msg_sent=s.msg_sent + sent,
-            msg_delivered=s.msg_delivered + is_msg.astype(jnp.int32),
-            msg_dropped=s.msg_dropped + delivered_drop
-            + dropped.astype(jnp.int32),
-            ev_peak=jnp.maximum(s.ev_peak, high_water),
             oops=s.oops | jnp.where(overflow, T.OOPS_EVENT_OVERFLOW, 0)
             | jnp.where(s.now > T.T_INF - 64 * T.TICKS_PER_SEC,
                         T.OOPS_TIME_OVERFLOW, 0),

@@ -1,0 +1,13 @@
+"""Protocol workloads (the examples/ and downstream-crates analog).
+
+Each model is a set of `Program` state machines plus invariants and a
+`make_*_runtime` convenience constructor:
+
+  pingpong          — request/response with retries (endpoint examples)
+  rpc_echo          — client/server RPC service under faults (tonic-example)
+  raft              — leader election + log replication (MadRaft core)
+  raft_kv           — replicated KV with client histories + linearizability
+  two_phase_commit  — atomic commit with write-ahead state
+  gossip            — epidemic broadcast with anti-entropy push-back
+  bank              — Jepsen-style transfers with money conservation
+"""

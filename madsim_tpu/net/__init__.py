@@ -1,0 +1,7 @@
+"""Network layers over the engine's message fabric:
+
+  rpc      — typed request/response with call-id matching and retries
+  service  — @rpc method dispatch with stable hashed tags
+  stream   — ordered reliable delivery (sliding window, retransmission)
+  conn     — connection lifecycle (listen/connect/accept/reset)
+"""

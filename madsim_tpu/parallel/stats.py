@@ -41,9 +41,14 @@ def summarize(rt, state, seeds=None) -> dict:
         virtual_time_mean_us=float(now.mean()),
         virtual_time_max_us=int(now.max()),
         events_total=int(np.asarray(state.steps).sum()),
-        msgs_sent=int(np.asarray(state.msg_sent).sum()),
-        msgs_dropped=int(np.asarray(state.msg_dropped).sum()),
-        ev_peak_max=int(np.asarray(state.ev_peak).max()),
+        # None (not 0) when the run disabled stat collection — a literal 0
+        # would read as "no traffic" in dashboards
+        msgs_sent=(int(np.asarray(state.msg_sent).sum())
+                   if rt.cfg.collect_stats else None),
+        msgs_dropped=(int(np.asarray(state.msg_dropped).sum())
+                      if rt.cfg.collect_stats else None),
+        ev_peak_max=(int(np.asarray(state.ev_peak).max())
+                     if rt.cfg.collect_stats else None),
         # schedule-space coverage proxy: distinct terminal states
         distinct_outcomes=int(len(np.unique(fps))),
         oops=int((np.asarray(state.oops) != 0).sum()),

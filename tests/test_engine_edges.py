@@ -95,3 +95,14 @@ class TestEdges:
                      state_spec())
         state, _ = rt.run(rt.init_single(0), 4000)
         assert bool(state.halted.all()) and not bool(state.crashed.any())
+
+
+class TestStatsFlag:
+    def test_collect_stats_off_keeps_counters_zero(self):
+        cfg = SimConfig(n_nodes=3, time_limit=sec(5), collect_stats=False)
+        rt = Runtime(cfg, [PingPong(3, target=5)], state_spec())
+        state, _ = rt.run(rt.init_batch(np.arange(4)), 4000)
+        assert bool(state.halted.all()) and not bool(state.crashed.any())
+        assert int(np.asarray(state.msg_sent).sum()) == 0
+        assert int(np.asarray(state.ev_peak).sum()) == 0
+        assert int(np.asarray(state.steps).sum()) > 0   # steps still count
