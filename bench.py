@@ -68,34 +68,103 @@ def _events_per_sec(batch: int, steps: int, warm: int) -> float:
     return batch * steps / dt
 
 
+def _cpu_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disable TPU sitecustomize hook
+    return env
+
+
+def _tpu_alive(timeout: float = 90.0) -> bool:
+    """Bounded preflight: probe jax.devices() in a subprocess.
+
+    The TPU here is one chip behind a tunnel that can wedge (a hung tunnel
+    makes even jax.devices() block forever in-process); probing in a
+    killable child keeps this process healthy either way.
+    """
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return False
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(d[0].platform if d else 'none')"],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False
+    plat = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    return out.returncode == 0 and plat not in ("", "none", "cpu")
+
+
+def _batched_eps_with_retry(platform: str) -> float:
+    """Timed batched run; one retry for transient tunnel flakes."""
+    last = None
+    for attempt in (1, 2):
+        try:
+            return _events_per_sec(B_TPU, STEPS, WARM)
+        except Exception as e:  # noqa: BLE001 - retry then surface
+            last = e
+            print(f"{platform} batched run attempt {attempt} failed: {e!r}",
+                  file=sys.stderr)
+    raise last
+
+
 def main():
     if "--cpu-baseline" in sys.argv:
         # single-seed sequential loop on CPU: the reference execution model
         print(_events_per_sec(1, CPU_STEPS, WARM))
         return
 
-    # CPU baseline in a clean subprocess (this process owns the TPU)
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # disable TPU sitecustomize hook
+    # CPU baseline in a clean subprocess (this process may own the TPU)
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--cpu-baseline"],
-        capture_output=True, text=True, env=env, check=True,
+        capture_output=True, text=True, env=_cpu_env(), check=True,
         cwd=os.path.dirname(os.path.abspath(__file__)))
     cpu_eps = float(out.stdout.strip().splitlines()[-1])
     print(f"cpu single-seed baseline: {cpu_eps:,.0f} events/s",
           file=sys.stderr)
 
-    tpu_eps = _events_per_sec(B_TPU, STEPS, WARM)
-    print(f"tpu batched ({B_TPU} seeds): {tpu_eps:,.0f} seed-events/s",
-          file=sys.stderr)
+    # Preflight the chip (retry once: the tunnel sometimes needs a nudge).
+    on_tpu = _tpu_alive() or _tpu_alive()
+    if not on_tpu:
+        # No chip: fall back to batched-on-CPU so the round still records
+        # a real speedup number instead of a traceback. Env vars alone do
+        # NOT unpin the sitecustomize-registered TPU platform — the config
+        # update (before any jax device touch in this process) is what
+        # actually switches; without it this fallback would hang on the
+        # same wedged tunnel the preflight just detected.
+        print("tpu preflight failed; falling back to batched CPU",
+              file=sys.stderr)
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
-    print(json.dumps({
+    batched_eps = _batched_eps_with_retry("tpu" if on_tpu else "cpu")
+    print(f"{'tpu' if on_tpu else 'cpu'} batched ({B_TPU} seeds): "
+          f"{batched_eps:,.0f} seed-events/s", file=sys.stderr)
+
+    result = {
         "metric": "madraft_fuzz_seed_events_per_sec",
-        "value": round(tpu_eps, 1),
+        "value": round(batched_eps, 1),
         "unit": "seed*events/s (5-node Raft, chaos scenario)",
-        "vs_baseline": round(tpu_eps / cpu_eps, 2),
-    }))
+        "vs_baseline": round(batched_eps / cpu_eps, 2),
+    }
+    if not on_tpu:
+        result["note"] = "tpu unavailable; batched side ran on CPU"
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 - driver wants one JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "madraft_fuzz_seed_events_per_sec",
+            "value": 0,
+            "unit": "seed*events/s (5-node Raft, chaos scenario)",
+            "vs_baseline": 0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(0)

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll the axon TPU tunnel; when it answers, run bench.py on the chip.
+# Probe uses a killable child (a wedged tunnel hangs jax.devices forever);
+# the bench run itself gets no timeout (killing mid-compile wedges the
+# device claim — see memory/axon-tpu-quirks).
+cd /root/repo
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform not in ('cpu','none')" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) tunnel alive, running bench" >> tpu_watch.log
+    python bench.py > BENCH_tpu.json 2>> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) bench done rc=$?" >> tpu_watch.log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) probe $i: tunnel dead" >> tpu_watch.log
+  sleep 240
+done
+echo "gave up" >> tpu_watch.log
+exit 1

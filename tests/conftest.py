@@ -18,3 +18,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: most suite wall-clock is XLA recompiles of
+# near-identical step programs (every test builds a Runtime with its own
+# static shapes). Caching them across runs cuts the suite from ~12min to
+# the actual execution time.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
