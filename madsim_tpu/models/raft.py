@@ -23,16 +23,44 @@ Message schema (payload words):
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.api import Ctx, Program
 from ..core.types import ms
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
-# message tags
-RV, RVR, AE, AER = 1, 2, 3, 4
+# message tags (5/6 are taken by raft_kv's CMD/CRSP)
+RV, RVR, AE, AER, IS = 1, 2, 3, 4, 9
 # timer tags
 T_ELECTION, T_HEARTBEAT, T_PROPOSE = 1, 2, 3
+
+# Snapshot digest: an order-dependent hash chain over the compacted log
+# prefix. Discarded entries stay checkable — State Machine Safety compares
+# digests (extended over live entries where bases differ) instead of the
+# entries themselves. All arithmetic is int32 wraparound (mod 2^32), which
+# keeps the fold exactly associative, so vectorized reduction order can't
+# change the result.
+DIGEST_P = 1000003     # chain multiplier (odd — invertible mod 2^32)
+DIGEST_MIX = 920419823  # column-fold multiplier
+
+
+def _pow_table(L: int) -> jnp.ndarray:
+    """[L+1] table of DIGEST_P**k mod 2^32, as two's-complement int32."""
+    out = np.empty(L + 1, np.int64)
+    v = 1
+    for k in range(L + 1):
+        out[k] = v if v < 2 ** 31 else v - 2 ** 32
+        v = (v * DIGEST_P) % 2 ** 32
+    return jnp.asarray(out, jnp.int32)
+
+
+def entry_hash(term_col, field_cols):
+    """Mix one log entry's columns into a single int32 word (per slot)."""
+    h = term_col
+    for c in field_cols:
+        h = h * DIGEST_MIX + c
+    return h
 
 # crash codes (invariant violations)
 CRASH_TWO_LEADERS = 101
@@ -54,6 +82,12 @@ def state_spec(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
         voted_for=jnp.asarray(-1, jnp.int32),
         log_term=jnp.zeros((L,), jnp.int32),
         log_len=z,
+        # snapshot (Raft §7): physical slot k holds absolute entry
+        # snap_len + k; entries below snap_len are summarized by the
+        # digest chain. log_len / commit / match / next stay ABSOLUTE.
+        snap_len=z,
+        snap_term=z,
+        snap_digest=z,
         # volatile
         role=z,
         votes=z,
@@ -75,6 +109,7 @@ def persist_spec(fields=("cmd",), extra=None):
     """Which leaves are stable storage (Raft Figure 2 'persistent state')."""
     mask = dict(
         term=True, voted_for=True, log_term=True, log_len=True,
+        snap_len=True, snap_term=True, snap_digest=True,
         role=False, votes=False, commit=False, next_idx=False,
         match_idx=False, egen=False, hgen=False, nprop=False,
     )
@@ -109,7 +144,8 @@ class Raft(Program):
                  election_min=ms(150), election_max=ms(300),
                  heartbeat_every=ms(50), propose_every=ms(100),
                  majority_override: int | None = None,
-                 n_peers: int | None = None):
+                 n_peers: int | None = None,
+                 compact_threshold: int = 0):
         self.n = n_nodes
         # raft peers occupy nodes [0, n_peers); the rest of the cluster
         # (e.g. KV clients) never votes, replicates, or receives broadcasts
@@ -124,6 +160,11 @@ class Raft(Program):
         # prove the invariant checker catches real protocol bugs
         self.majority = (majority_override if majority_override is not None
                          else self.npeers // 2 + 1)
+        # log compaction (Raft §7): once the applied/committed prefix grows
+        # past this many entries, fold it into the snapshot and slide the
+        # window. 0 disables (logs must then fit log_capacity forever).
+        self.compact_threshold = compact_threshold
+        self._powP = _pow_table(log_capacity)
 
     ENTRY_FIELDS = ("cmd",)
 
@@ -140,11 +181,42 @@ class Raft(Program):
     def _on_become_leader(self, ctx, st, become_leader):
         pass
 
+    def _compact_limit(self, st):
+        """Highest absolute index the snapshot may cover (default: commit).
+        RaftKv returns its applied pointer so the materialized state-machine
+        image always sits exactly at the compaction boundary."""
+        return st["commit"]
+
+    def _snapshot_extra(self, ctx, st, do, shift):
+        """Hook: capture extra state-machine summary when compacting `shift`
+        entries (called BEFORE the window slides)."""
+
+    def _is_extra_words(self, ctx, st):
+        """Hook: extra InstallSnapshot payload words after the 4-word header
+        (RaftKv ships chunked state-machine images here). Width must not
+        exceed 2 + len(ENTRY_FIELDS)."""
+        return []
+
+    def _install_ready(self, ctx, st, want, payload):
+        """Hook: stage incoming snapshot data; return a mask of whether the
+        snapshot is complete enough to install now. The base single-message
+        snapshot is always complete."""
+        return want
+
+    def _install_extra(self, ctx, st, inst, payload):
+        """Hook: adopt extra snapshot state from an InstallSnapshot."""
+
+    def _on_commit_progress(self, ctx, st, active):
+        """Hook: called once per message event after commit may have moved
+        (follower AE, leader AER, or snapshot install) — RaftKv drains its
+        apply loop here."""
+
     def _append(self, ctx, st, when, vals):
         """Leader-side masked append of one entry (term = current term).
         Shared by the propose tick, client commands, and election no-ops."""
-        when = when & (st["log_len"] < self.L)
-        widx = jnp.clip(st["log_len"], 0, self.L - 1)
+        live = st["log_len"] - st["snap_len"]
+        when = when & (live < self.L)
+        widx = jnp.clip(live, 0, self.L - 1)
         st["log_term"] = st["log_term"].at[widx].set(
             jnp.where(when, st["term"], st["log_term"][widx]))
         for f in self.ENTRY_FIELDS:
@@ -157,9 +229,52 @@ class Raft(Program):
 
     # -- helpers ----------------------------------------------------------
     def _last_term(self, st):
-        return jnp.where(st["log_len"] > 0,
-                         st["log_term"][jnp.clip(st["log_len"] - 1, 0,
-                                                 self.L - 1)], 0)
+        return jnp.where(
+            st["log_len"] > st["snap_len"],
+            st["log_term"][jnp.clip(st["log_len"] - 1 - st["snap_len"], 0,
+                                    self.L - 1)],
+            st["snap_term"])
+
+    def _entry_hash(self, st):
+        return entry_hash(st["log_term"],
+                          [st[f"log_{f}"] for f in self.ENTRY_FIELDS])
+
+    def _shift_log(self, st, shift, live):
+        """Slide the log window left by `shift` slots, zeroing all slots
+        past the `live` surviving entries (gather — jnp.roll with a traced
+        shift lowers poorly on TPU)."""
+        ks = jnp.arange(self.L, dtype=jnp.int32)
+        src_idx = (ks + shift) % self.L
+        keep = ks < live
+        for c in ("log_term",) + tuple(f"log_{f}" for f in self.ENTRY_FIELDS):
+            st[c] = jnp.where(keep, st[c][src_idx], 0)
+
+    def _maybe_compact(self, ctx, st, when):
+        """Fold the committed prefix into the snapshot once it exceeds
+        compact_threshold entries, then slide the window. The digest chain
+        is extended over exactly the entries being discarded, so safety
+        checks on the prefix survive the discard."""
+        if not self.compact_threshold:
+            return
+        L = self.L
+        sl = st["snap_len"]
+        target = jnp.minimum(self._compact_limit(st), st["log_len"])
+        shift = jnp.maximum(target - sl, 0)
+        do = jnp.asarray(when) & (shift >= self.compact_threshold)
+        shift = jnp.where(do, shift, 0)
+        ks = jnp.arange(L, dtype=jnp.int32)
+        h = self._entry_hash(st)
+        w = self._powP[jnp.clip(shift - 1 - ks, 0, L)]
+        contrib = jnp.where(ks < shift, h * w, 0).sum()
+        self._snapshot_extra(ctx, st, do, shift)
+        st["snap_digest"] = jnp.where(
+            do, st["snap_digest"] * self._powP[shift] + contrib,
+            st["snap_digest"])
+        st["snap_term"] = jnp.where(
+            do, st["log_term"][jnp.clip(shift - 1, 0, L - 1)],
+            st["snap_term"])
+        st["snap_len"] = st["snap_len"] + shift
+        self._shift_log(st, shift, st["log_len"] - st["snap_len"])
 
     def _arm_election(self, ctx, st, when):
         st["egen"] = st["egen"] + jnp.asarray(when, jnp.int32)
@@ -169,6 +284,9 @@ class Raft(Program):
     # -- lifecycle --------------------------------------------------------
     def init(self, ctx: Ctx):
         st = dict(ctx.state)  # persistent leaves carry over from before
+        # the snapshot IS applied state: a restarted node resumes with its
+        # commit floor at the compacted prefix (volatile commit was reset)
+        st["commit"] = jnp.maximum(st["commit"], st["snap_len"])
         self._arm_election(ctx, st, True)
         ctx.set_timer(ctx.randint(0, self.prop), T_PROPOSE, [0])
         ctx.state = st
@@ -193,27 +311,40 @@ class Raft(Program):
         #  *ENTRY_FIELDS, has_entry]
         is_hb = ((tag == T_HEARTBEAT) & (payload[0] == st["hgen"])
                  & (st["role"] == LEADER))
-        # election RV and heartbeat AE broadcasts are mutually exclusive,
-        # so they SHARE send slots — per-peer emission count (the dominant
-        # per-step engine cost) is npeers, not 2*npeers
+        # election RV, heartbeat AE, and snapshot IS are mutually exclusive
+        # per peer, so they SHARE send slots — per-peer emission count (the
+        # dominant per-step engine cost) is npeers, not 3*npeers
         zero = jnp.zeros_like(st["term"])
+        sl = st["snap_len"]
         rv_payload = jnp.stack(
             [st["term"], st["log_len"], last_t]
             + [zero] * (3 + len(self.ENTRY_FIELDS)))
+        # InstallSnapshot (§7): a follower whose next entry was compacted
+        # away can't be caught up by AE — ship the snapshot summary instead
+        extra = self._is_extra_words(ctx, st)
+        pad = 2 + len(self.ENTRY_FIELDS) - len(extra)
+        assert pad >= 0, "IS extra words exceed the shared payload width"
+        is_payload = jnp.stack(
+            [st["term"], sl, st["snap_term"], st["snap_digest"]]
+            + list(extra) + [zero] * pad)
         for p in range(self.npeers):
             nxt = st["next_idx"][p]
+            need_is = nxt < sl
             has = nxt < st["log_len"]
-            prev_term = jnp.where(nxt > 0,
-                                  st["log_term"][jnp.clip(nxt - 1, 0, L - 1)],
-                                  0)
-            eidx = jnp.clip(nxt, 0, L - 1)
+            prev_term = jnp.where(
+                nxt > sl,
+                st["log_term"][jnp.clip(nxt - 1 - sl, 0, L - 1)],
+                st["snap_term"])
+            eidx = jnp.clip(nxt - sl, 0, L - 1)
             ae_payload = jnp.stack(
                 [st["term"], nxt, prev_term, st["commit"],
                  st["log_term"][eidx]]
                 + [st[f"log_{f}"][eidx] for f in self.ENTRY_FIELDS]
                 + [has.astype(jnp.int32)])
-            ctx.send(p, jnp.where(is_el, RV, AE),
-                     jnp.where(is_el, rv_payload, ae_payload),
+            ctx.send(p,
+                     jnp.where(is_el, RV, jnp.where(need_is, IS, AE)),
+                     jnp.where(is_el, rv_payload,
+                               jnp.where(need_is, is_payload, ae_payload)),
                      when=(is_el | is_hb) & (p != ctx.node))
         ctx.set_timer(self.hb, T_HEARTBEAT, [st["hgen"]], when=is_hb)
 
@@ -235,7 +366,8 @@ class Raft(Program):
         N, L = self.n, self.L
         majority = self.majority
         term_in = payload[0]
-        is_raft_msg = (tag == RV) | (tag == RVR) | (tag == AE) | (tag == AER)
+        is_raft_msg = ((tag == RV) | (tag == RVR) | (tag == AE)
+                       | (tag == AER) | (tag == IS))
 
         # a RAFT message with a higher term: step down (Raft §5.1). Gated on
         # tag — other protocols' payload[0] (e.g. a client call id) is NOT a
@@ -276,23 +408,29 @@ class Raft(Program):
         # ---- AppendEntries (§5.3) ---------------------------------------
         F = len(self.ENTRY_FIELDS)
         is_ae = tag == AE
+        is_is = tag == IS
         prev, prev_t = payload[1], payload[2]
         lcommit, e_term = payload[3], payload[4]
         e_fields = {f: payload[5 + i]
                     for i, f in enumerate(self.ENTRY_FIELDS)}
         has = payload[5 + F] == 1
-        from_leader = is_ae & (term_in == st["term"])
+        from_leader = (is_ae | is_is) & (term_in == st["term"])
         # a candidate discovering the elected leader returns to follower
         st["role"] = jnp.where(from_leader & (st["role"] == CANDIDATE),
                                FOLLOWER, st["role"])
-        prev_ok = (prev == 0) | ((prev <= st["log_len"])
-                                 & (st["log_term"][jnp.clip(prev - 1, 0,
-                                                            L - 1)] == prev_t))
-        ok = from_leader & prev_ok & (~has | (prev < L))
-        conflict = has & (prev < st["log_len"]) & (
-            st["log_term"][jnp.clip(prev, 0, L - 1)] != e_term)
-        widx = jnp.clip(prev, 0, L - 1)
-        write = ok & has
+        sl = st["snap_len"]
+        # absolute indices < snap_len are committed, snapshotted state:
+        # the prefix check passes there by State Machine Safety; above it,
+        # compare the term stored in the sliding window (slot = abs - sl)
+        prev_ok = (prev <= sl) | (
+            (prev <= st["log_len"])
+            & (st["log_term"][jnp.clip(prev - 1 - sl, 0, L - 1)] == prev_t))
+        ok = (is_ae & (term_in == st["term"])) & prev_ok & (
+            ~has | (prev - sl < L))
+        write = ok & has & (prev >= sl)  # can't write below the snapshot
+        conflict = write & (prev < st["log_len"]) & (
+            st["log_term"][jnp.clip(prev - sl, 0, L - 1)] != e_term)
+        widx = jnp.clip(prev - sl, 0, L - 1)
         st["log_term"] = st["log_term"].at[widx].set(
             jnp.where(write, e_term, st["log_term"][widx]))
         for f in self.ENTRY_FIELDS:
@@ -303,12 +441,43 @@ class Raft(Program):
                              jnp.maximum(st["log_len"], prev + 1)),
             st["log_len"])
         st["log_len"] = new_len
-        match = jnp.where(ok, prev + write, 0)
+        # an entry below the snapshot is already covered: report the
+        # snapshot boundary as matched so the leader's next_idx advances
+        match = jnp.where(ok, jnp.maximum(sl, prev + write), 0)
         st["commit"] = jnp.where(
             ok, jnp.maximum(st["commit"], jnp.minimum(lcommit, new_len)),
             st["commit"])
-        ctx.send(src, AER,
-                 [st["term"], ok.astype(jnp.int32), match], when=is_ae)
+
+        # ---- InstallSnapshot (§7, follower side) ------------------------
+        # Adopt the leader's compacted prefix; keep our suffix only if it
+        # extends the snapshot with a matching last-included entry,
+        # otherwise the whole log is superseded.
+        s_len, s_term, s_dig = payload[1], payload[2], payload[3]
+        want = is_is & (term_in == st["term"]) & (s_len > sl)
+        inst = want & self._install_ready(ctx, st, want, payload)
+        have_suffix = inst & (st["log_len"] >= s_len) & (
+            st["log_term"][jnp.clip(s_len - 1 - sl, 0, L - 1)] == s_term)
+        keep_len = jnp.where(inst,
+                             jnp.where(have_suffix, st["log_len"], s_len),
+                             st["log_len"])
+        self._shift_log(st, jnp.where(inst, s_len - sl, 0),
+                        keep_len - jnp.where(inst, s_len, sl))
+        st["log_len"] = keep_len
+        st["snap_len"] = jnp.where(inst, s_len, st["snap_len"])
+        st["snap_term"] = jnp.where(inst, s_term, st["snap_term"])
+        st["snap_digest"] = jnp.where(inst, s_dig, st["snap_digest"])
+        st["commit"] = jnp.where(inst, jnp.maximum(st["commit"], s_len),
+                                 st["commit"])
+        self._install_extra(ctx, st, inst, payload)
+
+        # AE and IS replies share the AER slot (mutually exclusive tags).
+        # The IS match reports the POST-install snap_len: an installed
+        # snapshot advances the leader past it; a partially staged chunked
+        # snapshot reports the old boundary so the leader keeps sending.
+        aer_ok = jnp.where(is_is, 1, ok.astype(jnp.int32))
+        aer_match = jnp.where(is_is, st["snap_len"], match)
+        ctx.send(src, AER, [st["term"], aer_ok, aer_match],
+                 when=is_ae | is_is)
 
         # ---- AppendEntries reply (leader side) --------------------------
         is_aer = ((tag == AER) & (st["role"] == LEADER)
@@ -326,21 +495,27 @@ class Raft(Program):
                                 jnp.maximum(st["next_idx"][src] - 1, 0),
                                 st["next_idx"][src])))
         # advance commit: majority-replicated entries of the current term
-        # (§5.4.2 — never commit prior-term entries by counting)
+        # (§5.4.2 — never commit prior-term entries by counting). Slot k
+        # holds absolute entry snap_len + k; match_idx is absolute.
         ks = jnp.arange(L, dtype=jnp.int32)
-        replicated = (st["match_idx"][None, :] >= ks[:, None] + 1)  # [L, N]
+        abs_idx = st["snap_len"] + ks
+        replicated = (st["match_idx"][None, :] >= abs_idx[:, None] + 1)
         cnt = replicated.sum(axis=1)
-        committable = ((cnt >= majority) & (ks < st["log_len"])
+        committable = ((cnt >= majority) & (abs_idx < st["log_len"])
                        & (st["log_term"] == st["term"]))
-        best = jnp.max(jnp.where(committable, ks + 1, 0))
+        best = jnp.max(jnp.where(committable, abs_idx + 1, 0))
         prev_commit = st["commit"]
         st["commit"] = jnp.where(is_aer,
                                  jnp.maximum(st["commit"], best), st["commit"])
         self._on_leader_commit(ctx, st, prev_commit, is_aer)
+        self._on_commit_progress(ctx, st, ok | is_aer | inst)
 
         # ---- election timer reset (vote granted or live leader heard) ---
         self._arm_election(ctx, st, grant | from_leader)
         self._extra_message(ctx, st, src, tag, payload)
+        # compaction rides commit advancement: followers after AE, the
+        # leader after AER (self-propose commits also flow through AER)
+        self._maybe_compact(ctx, st, ok | is_aer)
         if self.halt_on_commit:
             ctx.halt_if(st["commit"] >= self.halt_on_commit)
         ctx.state = st
@@ -362,6 +537,7 @@ def raft_invariant(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
     eye = jnp.eye(N, dtype=bool)
     peer = (jnp.ones((N,), bool) if raft_nodes is None
             else jnp.asarray(raft_nodes, bool))
+    powP = _pow_table(L)
 
     def invariant(state):
         ns = state.node_state
@@ -371,18 +547,43 @@ def raft_invariant(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
         two_leaders = (leader[:, None] & leader[None, :] & same_term
                        & ~eye).any()
 
-        commit = jnp.where(peer, ns["commit"], 0)
-        both_committed = jnp.minimum(commit[:, None], commit[None, :])  # [N,N]
+        sl = jnp.where(peer, ns["snap_len"], 0)
+        loglen = jnp.where(peer, ns["log_len"], 0)
+        # effective commit: the snapshot is applied state, so it floors the
+        # commit index (covers the restart window before init re-raises it)
+        ec = jnp.maximum(jnp.where(peer, ns["commit"], 0), sl)
+        dig = ns["snap_digest"]
+        h = entry_hash(ns["log_term"], [ns[f"log_{f}"] for f in fields])
         ks = jnp.arange(L, dtype=jnp.int32)
-        in_prefix = ks[None, None, :] < both_committed[:, :, None]  # [N,N,L]
-        term_neq = ns["log_term"][:, None, :] != ns["log_term"][None, :, :]
-        neq = term_neq
-        for f in fields:
-            col = ns[f"log_{f}"]
-            neq = neq | (col[:, None, :] != col[None, :, :])
-        mismatch = (in_prefix & neq).any()
+        pair = peer[:, None] & peer[None, :] & ~eye
 
-        commit_gt = (commit > jnp.where(peer, ns["log_len"], 0)).any()
+        # (a) snapshot-chain consistency: where node j compacted further
+        # than node i (sl_i <= sl_j <= ec_i), j's digest must equal i's
+        # digest extended over i's live entries [sl_i, sl_j) — discarded
+        # history stays cross-checkable
+        m = sl[None, :] - sl[:, None]                               # [N,N]
+        applicable = pair & (m >= 0) & (sl[None, :] <= ec[:, None])
+        w = powP[jnp.clip(m[:, :, None] - 1 - ks[None, None, :], 0, L)]
+        contrib = jnp.where(ks[None, None, :] < m[:, :, None],
+                            h[:, None, :] * w, 0).sum(-1)           # [N,N]
+        ext = dig[:, None] * powP[jnp.clip(m, 0, L)] + contrib
+        chain_bad = (applicable & (ext != dig[None, :])).any()
+
+        # (b) live committed regions agree entry-by-entry, aligned by
+        # absolute index: i's slot k is absolute a = sl_i + k, which sits
+        # at slot a - sl_j in j's window
+        a = sl[:, None, None] + ks[None, None, :]                   # [N,1,L]
+        both = jnp.minimum(ec[:, None], ec[None, :])                # [N,N]
+        in_rng = (a >= sl[None, :, None]) & (a < both[:, :, None])
+        idx_j = jnp.clip(a - sl[None, :, None], 0, L - 1)           # [N,N,L]
+        neq = jnp.zeros(idx_j.shape, bool)
+        for col in [ns["log_term"]] + [ns[f"log_{f}"] for f in fields]:
+            cj = jnp.take_along_axis(
+                jnp.broadcast_to(col[None, :, :], (N, N, L)), idx_j, axis=2)
+            neq = neq | (col[:, None, :] != cj)
+        mismatch = (pair[:, :, None] & in_rng & neq).any() | chain_bad
+
+        commit_gt = (ec > loglen).any()
 
         bad = two_leaders | mismatch | commit_gt
         code = jnp.where(

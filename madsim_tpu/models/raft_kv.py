@@ -1,6 +1,6 @@
 """Replicated KV register store on Raft, with client-observed histories —
-the full MadRaft workload (BASELINE.md config 4: log replication +
-linearizability fuzz).
+the full MadRaft workload (BASELINE.md config 4: log replication + snapshots
++ linearizability fuzz).
 
 Cluster layout: nodes [0, R) run RaftKv (the consensus core of
 models/raft.py with a richer log entry: op/key/val/client/rtag); nodes
@@ -9,10 +9,21 @@ on timeout. Clients record an invocation/response history into fixed-size
 state arrays; the host extracts it after the run and feeds it to the
 linearizability checker (madsim_tpu/native.py — C++ with Python fallback).
 
-Exactly-once: entries carry (client, rtag); a leader deduplicates retries
-against its own authoritative log, and replies immediately for already-
-committed duplicates. GETs are linearized through the log like writes
-(no lease/read-index shortcut), so every response is a committed operation.
+State machine: every node applies committed entries in order into a
+materialized image (kv registers + per-client session table), bounded per
+event by `apply_per_event`. The leader replies at apply time. Exactly-once:
+entries carry (client, rtag); retries dedup against the session table (for
+applied ops — their log entries may be compacted away) and against the live
+log window (for in-flight ops). GETs are linearized through the log like
+writes, so every response is a committed operation.
+
+Snapshots (Raft §7): compaction folds exactly the applied prefix, capturing
+the (kv, sessions) image at that boundary. InstallSnapshot ships the image
+CHUNKED over the fixed-width payload (the madsim analog is tonic streaming
+a snapshot blob): each IS carries [chunk_idx, n_chunks, words...] after the
+4-word header; followers stage chunks keyed by snap_len and install only
+when the image is complete — the bulk-data-over-fixed-payload pattern
+DESIGN.md prescribes.
 """
 
 from __future__ import annotations
@@ -25,18 +36,43 @@ from ..core.types import ms
 from . import raft as R
 
 OP_PUT, OP_GET = 1, 2
-# message tags (beyond RV/RVR/AE/AER = 1..4)
+# message tags (beyond RV/RVR/AE/AER/IS = 1..4, 9)
 CMD, CRSP = 5, 6
 # client timer tags
 T_NEW, T_RETRY = 4, 5
 
 KV_FIELDS = ("op", "key", "val", "client", "rtag")
+# IS data words per chunk: rides the slots the AE entry fields occupy, so
+# every payload variant stacks to the same width
+CHUNK_WORDS = len(KV_FIELDS)
 
 
-def kv_state_spec(n_nodes: int, log_capacity: int, n_ops: int):
+def _image_words(n_keys: int, n_clients: int) -> int:
+    """Flattened snapshot image: kv registers + session (rtag, val) rows."""
+    return n_keys + 2 * n_clients
+
+
+def kv_state_spec(n_nodes: int, log_capacity: int, n_ops: int,
+                  n_keys: int = 4, n_clients: int = 3):
     z = jnp.asarray(0, jnp.int32)
+    K, NC = n_keys, n_clients
+    SW = _image_words(K, NC)
     extra = dict(
-        last_replied=z,
+        # materialized state machine (persistent — it IS applied state)
+        kv=jnp.zeros((K,), jnp.int32),
+        applied=z,
+        sess_rtag=jnp.zeros((NC,), jnp.int32),
+        sess_val=jnp.zeros((NC,), jnp.int32),
+        # frozen image at snap_len, captured at compaction — IS chunks read
+        # this so a multi-chunk transfer stays internally consistent even
+        # while the live kv keeps advancing
+        snap_kv=jnp.zeros((K,), jnp.int32),
+        snap_sess_rtag=jnp.zeros((NC,), jnp.int32),
+        snap_sess_val=jnp.zeros((NC,), jnp.int32),
+        # incoming-snapshot staging (volatile — restart restages)
+        stage_buf=jnp.zeros((SW,), jnp.int32),
+        stage_mask=z,
+        stage_slen=z,
         # client-side bookkeeping
         c_target=z, c_id=z, c_op=z, c_key=z, c_val=z, c_opn=z,
         c_wait=z,
@@ -50,10 +86,15 @@ def kv_state_spec(n_nodes: int, log_capacity: int, n_ops: int):
 
 
 def kv_persist_spec():
-    extra = dict(last_replied=None, c_target=None, c_id=None, c_op=None,
-                 c_key=None, c_val=None, c_opn=None, c_wait=None, h_op=None,
-                 h_key=None, h_val=None, h_inv=None, h_resp=None)
-    return R.persist_spec(KV_FIELDS, extra)
+    persist = ("kv", "applied", "sess_rtag", "sess_val",
+               "snap_kv", "snap_sess_rtag", "snap_sess_val")
+    volatile = dict(stage_buf=None, stage_mask=None, stage_slen=None,
+                    c_target=None, c_id=None, c_op=None, c_key=None,
+                    c_val=None, c_opn=None, c_wait=None, h_op=None,
+                    h_key=None, h_val=None, h_inv=None, h_resp=None)
+    mask = R.persist_spec(KV_FIELDS, volatile)
+    mask.update({k: True for k in persist})
+    return mask
 
 
 class RaftKv(R.Raft):
@@ -62,72 +103,78 @@ class RaftKv(R.Raft):
     ENTRY_FIELDS = KV_FIELDS
 
     def __init__(self, n_nodes: int, log_capacity: int = 64,
-                 replies_per_event: int = 2, **kw):
+                 apply_per_event: int = 2, n_keys: int = 4, **kw):
         super().__init__(n_nodes, log_capacity, n_cmds=0, **kw)
-        self.replies_per_event = replies_per_event
+        self.apply_per_event = apply_per_event
+        self.K = n_keys
+        self.NC = n_nodes - self.npeers          # client nodes [R, N)
+        self.SW = _image_words(self.K, self.NC)
+        self.n_chunks = -(-self.SW // CHUNK_WORDS)
+        assert self.n_chunks <= 31, "stage_mask is a single int32 bitmap"
 
     def _propose_fields(self, ctx, st):
         # RaftKv never self-proposes (n_cmds=0); entries come from clients
         z = jnp.asarray(0, jnp.int32)
         return {f: z for f in KV_FIELDS}
 
-    # -- read the register value an entry observes ------------------------
-    def _result_at(self, st, k):
-        """Result for log entry k: a PUT echoes its value; a GET reads the
-        last committed PUT to its key strictly before k (initial value 0)."""
-        L = self.L
-        kc = jnp.clip(k, 0, L - 1)
-        ks = jnp.arange(L, dtype=jnp.int32)
-        key_k = st["log_key"][kc]
-        isput = ((st["log_op"] == OP_PUT) & (st["log_key"] == key_k)
-                 & (ks < k))
-        lastput = jnp.max(jnp.where(isput, ks + 1, 0))
-        read = jnp.where(lastput > 0,
-                         st["log_val"][jnp.clip(lastput - 1, 0, L - 1)], 0)
-        return jnp.where(st["log_op"][kc] == OP_GET, read, st["log_val"][kc])
+    # -- the apply loop: committed entries -> (kv, sessions), in order ----
+    def _on_commit_progress(self, ctx: Ctx, st, active):
+        L, K = self.L, self.K
+        for _ in range(self.apply_per_event):
+            k = st["applied"]
+            can = active & (k < st["commit"]) & (k >= st["snap_len"])
+            slot = jnp.clip(k - st["snap_len"], 0, L - 1)
+            op = st["log_op"][slot]
+            key = jnp.clip(st["log_key"][slot], 0, K - 1)
+            client = st["log_client"][slot]
+            rtag = st["log_rtag"][slot]
+            do_put = can & (op == OP_PUT)
+            st["kv"] = st["kv"].at[key].set(
+                jnp.where(do_put, st["log_val"][slot], st["kv"][key]))
+            # post-write read: a PUT's result is its own value, a GET's is
+            # the register as of this log position — both are kv[key] now
+            result = st["kv"][key]
+            cid = jnp.clip(client - self.npeers, 0, self.NC - 1)
+            isop = can & (op != 0)                # no-op entries: no caller
+            st["sess_rtag"] = st["sess_rtag"].at[cid].set(
+                jnp.where(isop, rtag, st["sess_rtag"][cid]))
+            st["sess_val"] = st["sess_val"].at[cid].set(
+                jnp.where(isop, result, st["sess_val"][cid]))
+            ctx.send(client, CRSP, [rtag, result],
+                     when=isop & (st["role"] == R.LEADER))
+            st["applied"] = st["applied"] + can
 
-    # -- hooks into the consensus core ------------------------------------
+    # -- client commands ---------------------------------------------------
     def _extra_message(self, ctx: Ctx, st, src, tag, payload):
         L = self.L
         is_cmd = tag == CMD
         rtag, op, key, val = payload[0], payload[1], payload[2], payload[3]
         leader = st["role"] == R.LEADER
+        cid = jnp.clip(src - self.npeers, 0, self.NC - 1)
 
-        # dedup retries against the authoritative log (exactly-once)
+        # exactly-once, two levels: the session table answers retries of
+        # already-APPLIED ops (whose log entries may be compacted away);
+        # the live-window scan suppresses re-append of in-flight ops.
+        # rtags are MONOTONIC per client (KvClient issues c_opn + 1), so a
+        # delayed duplicate of an op OLDER than the session entry is
+        # rejected outright — with random ids it would be re-appended and
+        # re-executed once its original entry had been compacted away
+        sess_hit = st["sess_rtag"][cid] == rtag
+        stale = rtag < st["sess_rtag"][cid]
         ks = jnp.arange(L, dtype=jnp.int32)
-        dup = ((st["log_rtag"] == rtag) & (st["log_client"] == src)
-               & (ks < st["log_len"]))
-        dup_any = dup.any()
-        dup_idx = jnp.argmax(dup).astype(jnp.int32)
+        live = st["log_len"] - st["snap_len"]
+        pending = ((st["log_rtag"] == rtag) & (st["log_client"] == src)
+                   & (ks < live)).any()
 
-        self._append(ctx, st, is_cmd & leader & ~dup_any,
+        self._append(ctx, st,
+                     is_cmd & leader & ~sess_hit & ~stale & ~pending,
                      dict(op=op, key=key, val=val, client=src, rtag=rtag))
-
-        # a duplicate that already committed answers immediately
-        dup_done = is_cmd & leader & dup_any & (dup_idx < st["commit"])
-        ctx.send(src, CRSP, [rtag, self._result_at(st, dup_idx)],
-                 when=dup_done)
+        ctx.send(src, CRSP, [rtag, st["sess_val"][cid]],
+                 when=is_cmd & leader & sess_hit)
         # non-leaders drop client commands; the client's retry timer rotates
         # it to another node (no redirect hints — pure fuzzing pressure)
 
-    def _on_leader_commit(self, ctx: Ctx, st, prev_commit, is_aer):
-        base = st["last_replied"]
-        for j in range(self.replies_per_event):
-            k = base + j
-            kc = jnp.clip(k, 0, self.L - 1)
-            m = (is_aer & (st["role"] == R.LEADER) & (k < st["commit"])
-                 & (st["log_op"][kc] != 0))  # no-op entries have no caller
-            ctx.send(st["log_client"][kc], CRSP,
-                     [st["log_rtag"][kc], self._result_at(st, k)], when=m)
-        st["last_replied"] = jnp.where(
-            is_aer, jnp.minimum(st["commit"],
-                                base + self.replies_per_event), base)
-
     def _on_become_leader(self, ctx: Ctx, st, become_leader):
-        # entries committed under predecessors were already answered (or
-        # will be re-asked and hit the dedup fast path)
-        st["last_replied"] = jnp.where(become_leader, st["commit"],
-                                       st["last_replied"])
         # append a no-op entry (op=0): a leader can only count commits for
         # current-term entries (§5.4.2), and clients' retries dedup against
         # inherited entries instead of re-appending — without a fresh entry
@@ -138,6 +185,75 @@ class RaftKv(R.Raft):
         self._append(ctx, st,
                      become_leader & (st["commit"] < st["log_len"]),
                      {f: z for f in KV_FIELDS})
+
+    # -- snapshots ---------------------------------------------------------
+    def _compact_limit(self, st):
+        # compact exactly the applied prefix: the (kv, sessions) image then
+        # sits precisely at the new snap_len, so the captured shipping copy
+        # is the state AT the boundary
+        return st["applied"]
+
+    def _snapshot_extra(self, ctx, st, do, shift):
+        st["snap_kv"] = jnp.where(do, st["kv"], st["snap_kv"])
+        st["snap_sess_rtag"] = jnp.where(do, st["sess_rtag"],
+                                         st["snap_sess_rtag"])
+        st["snap_sess_val"] = jnp.where(do, st["sess_val"],
+                                        st["snap_sess_val"])
+
+    def _is_extra_words(self, ctx, st):
+        # rotate chunks on the heartbeat clock: every n_chunks ticks each
+        # lagging follower has seen the whole image (lossy links just take
+        # another cycle)
+        chunk = (ctx.now // self.hb) % self.n_chunks
+        svec = jnp.concatenate(
+            [st["snap_kv"], st["snap_sess_rtag"], st["snap_sess_val"]])
+        base = chunk * CHUNK_WORDS
+        words = []
+        for w in range(CHUNK_WORDS):
+            idx = jnp.clip(base + w, 0, self.SW - 1)
+            words.append(jnp.where(base + w < self.SW, svec[idx], 0))
+        return [chunk, jnp.asarray(self.n_chunks, jnp.int32)] + words
+
+    def _install_ready(self, ctx, st, want, payload):
+        # stage the incoming chunk, keyed by the snapshot's snap_len —
+        # chunks of a superseded snapshot are discarded wholesale
+        s_len, cidx = payload[1], payload[4]
+        fresh = want & (st["stage_slen"] != s_len)
+        st["stage_mask"] = jnp.where(fresh, 0, st["stage_mask"])
+        st["stage_slen"] = jnp.where(want, s_len, st["stage_slen"])
+        base = cidx * CHUNK_WORDS
+        for w in range(CHUNK_WORDS):
+            pos = jnp.clip(base + w, 0, self.SW - 1)
+            ok_w = want & (base + w < self.SW)
+            st["stage_buf"] = st["stage_buf"].at[pos].set(
+                jnp.where(ok_w, payload[6 + w], st["stage_buf"][pos]))
+        st["stage_mask"] = jnp.where(
+            want,
+            st["stage_mask"] | (1 << jnp.clip(cidx, 0, 30)),
+            st["stage_mask"])
+        return st["stage_mask"] == (1 << self.n_chunks) - 1
+
+    def _install_extra(self, ctx, st, inst, payload):
+        s_len = payload[1]
+        K, NC = self.K, self.NC
+        buf = st["stage_buf"]
+        # adopt the image only if it's ahead of our own applied state (a
+        # node that kept a matching suffix may already be further along)
+        adopt = inst & (st["applied"] < s_len)
+        st["kv"] = jnp.where(adopt, buf[:K], st["kv"])
+        st["sess_rtag"] = jnp.where(adopt, buf[K:K + NC], st["sess_rtag"])
+        st["sess_val"] = jnp.where(adopt, buf[K + NC:K + 2 * NC],
+                                   st["sess_val"])
+        st["applied"] = jnp.where(adopt, s_len, st["applied"])
+        # the installed image is also our shipping copy at the new
+        # snap_len — on EVERY install (not just adopt): snap_len moved to
+        # s_len, so keeping an image captured at the old boundary would
+        # ship a wrong snapshot if this node later leads
+        st["snap_kv"] = jnp.where(inst, buf[:K], st["snap_kv"])
+        st["snap_sess_rtag"] = jnp.where(inst, buf[K:K + NC],
+                                         st["snap_sess_rtag"])
+        st["snap_sess_val"] = jnp.where(inst, buf[K + NC:K + 2 * NC],
+                                        st["snap_sess_val"])
 
 
 class KvClient(Program):
@@ -158,6 +274,12 @@ class KvClient(Program):
         ctx.set_timer(ctx.randint(0, ms(20)), T_NEW, [0])
         ctx.state = st
 
+    # call ids are MONOTONIC per client (op index + 1): the server's
+    # session dedup can then reject a delayed duplicate of an OLDER op
+    # even after its log entry was compacted (see RaftKv._extra_message)
+    def _next_call_id(self, st):
+        return st["c_opn"] + 1
+
     def _issue(self, ctx, st, when):
         ctx.send(st["c_target"], CMD,
                  [st["c_id"], st["c_op"], st["c_key"], st["c_val"]],
@@ -168,7 +290,7 @@ class KvClient(Program):
         st = dict(ctx.state)
         start = ((tag == T_NEW) & (st["c_wait"] == 0)
                  & (st["c_opn"] < self.O))
-        st["c_id"] = jnp.where(start, ctx.randint(1, 2**30 - 1), st["c_id"])
+        st["c_id"] = jnp.where(start, self._next_call_id(st), st["c_id"])
         st["c_op"] = jnp.where(start,
                                jnp.where(ctx.bernoulli(0.5), OP_PUT, OP_GET),
                                st["c_op"])
@@ -218,7 +340,8 @@ def all_clients_done(n_raft: int, n_ops: int):
 
 
 def make_kv_runtime(n_raft=5, n_clients=3, n_keys=4, n_ops=12,
-                    log_capacity=64, scenario=None, cfg=None, **raft_kw):
+                    log_capacity=64, scenario=None, cfg=None,
+                    halt_when_all_done=True, **raft_kw):
     from ..core.types import NetConfig, SimConfig, sec
     from ..runtime.runtime import Runtime
     n = n_raft + n_clients
@@ -226,21 +349,24 @@ def make_kv_runtime(n_raft=5, n_clients=3, n_keys=4, n_ops=12,
         cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=12,
                         time_limit=sec(20))
     assert cfg.payload_words >= 6 + len(KV_FIELDS)
-    assert log_capacity >= n_clients * n_ops + 4, \
-        ("log must fit every client op plus slack for election no-ops "
-         "(one per leader change with uncommitted inherited entries)")
+    if not raft_kw.get("compact_threshold"):
+        assert log_capacity >= n_clients * n_ops + 4, \
+            ("without compaction the log must fit every client op plus "
+             "slack for election no-ops (one per leader change with "
+             "uncommitted inherited entries)")
     raft_kw.setdefault("n_peers", n_raft)  # quorum over servers, not clients
-    prog_raft = RaftKv(n, log_capacity, **raft_kw)
+    prog_raft = RaftKv(n, log_capacity, n_keys=n_keys, **raft_kw)
     prog_client = KvClient(n_raft, n_keys, n_ops)
     node_prog = np.asarray([0] * n_raft + [1] * n_clients, np.int32)
     peer_mask = np.asarray([True] * n_raft + [False] * n_clients)
     rt = Runtime(cfg, [prog_raft, prog_client],
-                 kv_state_spec(n, log_capacity, n_ops),
+                 kv_state_spec(n, log_capacity, n_ops, n_keys, n_clients),
                  node_prog=node_prog, scenario=scenario,
                  invariant=R.raft_invariant(n, log_capacity, KV_FIELDS,
                                             peer_mask),
                  persist=kv_persist_spec(),
-                 halt_when=all_clients_done(n_raft, n_ops))
+                 halt_when=(all_clients_done(n_raft, n_ops)
+                            if halt_when_all_done else None))
     return rt
 
 

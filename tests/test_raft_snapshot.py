@@ -1,0 +1,135 @@
+"""Raft log compaction + InstallSnapshot tests (Raft §7).
+
+The reference's MadRaft suite includes snapshot tests (BASELINE.md config 4);
+here the log window (`log_capacity`) is deliberately SMALLER than the total
+number of proposals, so trajectories only survive if compaction slides the
+window and lagging nodes recover via InstallSnapshot. Safety below the
+snapshot boundary is enforced by the digest-chain invariant, checked after
+every event.
+"""
+
+import jax
+import numpy as np
+
+from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
+from madsim_tpu.harness.simtest import run_seeds
+from madsim_tpu.models import raft as R
+from madsim_tpu.models.raft import make_raft_runtime
+
+N = 5
+L = 12          # window much smaller than total proposals
+CMDS = 30       # proposals > log_capacity: only works with compaction
+SEEDS = np.arange(6)
+
+
+def _rt(scenario=None, halt_on_commit=0, time_limit=sec(8), loss=0.0,
+        **raft_kw):
+    cfg = SimConfig(n_nodes=N, event_capacity=256, time_limit=time_limit,
+                    net=NetConfig(packet_loss_rate=loss,
+                                  send_latency_min=ms(1),
+                                  send_latency_max=ms(10)))
+    raft_kw.setdefault("compact_threshold", 4)
+    return make_raft_runtime(N, L, n_cmds=CMDS,
+                             halt_on_commit=halt_on_commit,
+                             scenario=scenario, cfg=cfg, **raft_kw)
+
+
+class TestCompaction:
+    def test_log_wraps_past_capacity(self):
+        # commit far more entries than the window holds; every live node
+        # must have compacted, and live window occupancy stays <= L
+        rt = _rt(halt_on_commit=CMDS, time_limit=sec(12))
+        state = run_seeds(rt, SEEDS, max_steps=30_000)
+        ns = state.node_state
+        commit = np.asarray(ns["commit"])
+        snap = np.asarray(ns["snap_len"])
+        loglen = np.asarray(ns["log_len"])
+        assert (commit.max(axis=1) >= CMDS).all()
+        assert (snap.max(axis=1) > 0).all()            # compaction happened
+        assert (loglen - snap <= L).all()              # window never overflows
+        assert (snap <= commit).all()                  # only committed compacts
+        # the invariant ran every event — reaching here means no violation
+
+    def test_equal_snapshots_have_equal_digests(self):
+        rt = _rt(halt_on_commit=CMDS, time_limit=sec(12))
+        state = run_seeds(rt, SEEDS, max_steps=30_000)
+        ns = state.node_state
+        snap = np.asarray(ns["snap_len"])
+        dig = np.asarray(ns["snap_digest"])
+        for b in range(len(SEEDS)):
+            for i in range(N):
+                for j in range(N):
+                    if snap[b, i] == snap[b, j] and snap[b, i] > 0:
+                        assert dig[b, i] == dig[b, j], (b, i, j)
+
+    def test_follower_catches_up_via_installsnapshot(self):
+        # node 0 dies before replication gets going; the rest commit and
+        # compact far past its log, so after restart AE alone cannot catch
+        # it up — only InstallSnapshot can
+        sc = Scenario()
+        sc.at(ms(400)).kill(0)
+        sc.at(sec(4)).restart(0)
+        rt = _rt(scenario=sc, time_limit=sec(10))
+        state = run_seeds(rt, SEEDS, max_steps=40_000)
+        ns = state.node_state
+        snap = np.asarray(ns["snap_len"])
+        commit = np.asarray(ns["commit"])
+        assert (commit.max(axis=1) >= CMDS).all()
+        # node 0 received a snapshot (its own log never reached snap_len
+        # entries before the kill) and caught up to the cluster
+        assert (snap[:, 0] > 0).all()
+        assert (commit[:, 0] >= CMDS - L).all()
+
+    def test_chaos_with_compaction_safety(self):
+        # rolling kills/restarts + a partition while the window wraps:
+        # the per-event invariant (incl. digest chain) must hold throughout
+        sc = Scenario()
+        for t in range(4):
+            sc.at(ms(700 + 900 * t)).kill_random()
+            sc.at(ms(1200 + 900 * t)).restart_random()
+        sc.at(sec(2)).partition([0, 1])
+        sc.at(sec(3)).heal()
+        rt = _rt(scenario=sc, time_limit=sec(8), loss=0.05)
+        state = run_seeds(rt, SEEDS, max_steps=40_000)
+        assert bool(state.halted.all())
+        assert not bool(np.asarray(state.crashed).any())
+
+
+class TestDigestChecker:
+    def test_tampered_digest_is_caught(self):
+        # the digest chain is a real safety net: corrupt one node's
+        # snapshot digest and the invariant must flag LOG_MISMATCH
+        rt = _rt(halt_on_commit=CMDS, time_limit=sec(12))
+        state = run_seeds(rt, SEEDS, max_steps=30_000)
+        s0 = jax.tree.map(lambda a: a[0], state)
+        inv = R.raft_invariant(N, L)
+        bad, _ = inv(s0)
+        assert not bool(bad)
+        ns = dict(s0.node_state)
+        victim = int(np.asarray(ns["snap_len"]).argmax())
+        ns["snap_digest"] = ns["snap_digest"].at[victim].add(1)
+        bad, code = inv(s0.replace(node_state=ns))
+        assert bool(bad)
+        assert int(code) == R.CRASH_LOG_MISMATCH
+
+    def test_tampered_live_entry_is_caught(self):
+        rt = _rt(halt_on_commit=CMDS, time_limit=sec(12))
+        state = run_seeds(rt, SEEDS, max_steps=30_000)
+        s0 = jax.tree.map(lambda a: a[0], state)
+        inv = R.raft_invariant(N, L)
+        ns = dict(s0.node_state)
+        # corrupt a committed live entry on the node with the most commits
+        victim = int(np.asarray(ns["commit"]).argmax())
+        snap = int(np.asarray(ns["snap_len"])[victim])
+        commit = int(np.asarray(ns["commit"])[victim])
+        assert commit > snap  # a live committed entry exists
+        ns["log_cmd"] = ns["log_cmd"].at[victim, 0].add(7)
+        bad, code = inv(s0.replace(node_state=ns))
+        assert bool(bad)
+        assert int(code) == R.CRASH_LOG_MISMATCH
+
+
+class TestDeterminism:
+    def test_replay_stable_with_compaction(self):
+        rt = _rt(time_limit=sec(3))
+        assert rt.check_determinism(seed=7, max_steps=8000)
