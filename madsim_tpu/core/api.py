@@ -92,8 +92,13 @@ class Ctx:
 
         Delivery is scheduled by the engine at now + Uniform[latency range],
         subject to packet loss and the clog matrix (network.rs:222-229).
-        `when` masks the send (handlers have static call counts).
+        `when` masks the send (handlers have static call counts; a
+        CONCRETELY-False mask — only possible in the eager real-world
+        runtime — skips the bookkeeping entirely).
         """
+        from ..utils.maskutil import statically_false
+        if statically_false(when):
+            return
         self._sends.append(dict(
             m=jnp.asarray(when) & jnp.asarray(True),
             dst=jnp.asarray(dst, jnp.int32),
@@ -104,6 +109,9 @@ class Ctx:
     def set_timer(self, delay, tag, payload=None, *, when=True) -> None:
         """Schedule on_timer(tag, payload) at now + delay ticks
         (time::sleep analog, time/sleep.rs)."""
+        from ..utils.maskutil import statically_false
+        if statically_false(when):
+            return
         self._timers.append(dict(
             m=jnp.asarray(when) & jnp.asarray(True),
             delay=jnp.maximum(jnp.asarray(delay, jnp.int32), 0),

@@ -1,0 +1,337 @@
+"""Streaming-RPC echo workload — the tonic-example streaming suite analog.
+
+The reference's tonic-example exercises unary, client-streaming,
+server-streaming and bidi methods against a sim network with loss and kills
+(tonic-example/src/server.rs:126-253 is the test shape; madsim-tonic
+client.rs:52-124 the machinery). This model does the same over the
+framed-stream fabric (net/streaming.py):
+
+  mode="bidi"      client pushes n items, server echoes each (paced through
+                   a backpressure ring, not fire-and-forget), both END
+  mode="sum"       client-streaming: n items up, one aggregate K_REPLY down
+  mode="download"  server-streaming: one request up, n items + END down
+
+Clients verify payloads in-model (ctx.crash_if), detect stalls (lost END,
+peer restart) and recover by resetting the peer stream and re-issuing the
+whole call with a fresh call id — the reconnect-after-channel-break idiom.
+Kill-mid-stream chaos is therefore survivable end-to-end: see
+tests/test_streaming.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.api import Ctx, Program
+from ..core.types import ms
+from ..net import conn, streaming
+from ..net.service import Service, rpc_stream
+
+T_TICK = 1
+
+CRASH_BAD_ECHO = 201
+CRASH_BAD_SUM = 202
+CRASH_BAD_DOWNLOAD = 203
+
+SERVER = 0          # node 0 serves; nodes 1.. are clients
+ECHO_RING = 8       # server-side backpressure buffer per client
+
+
+def echo_state_spec(n_nodes: int, window: int = 4):
+    z = jnp.asarray(0, jnp.int32)
+    N = n_nodes
+    return dict(
+        **conn.conn_state(N),
+        **streaming.streaming_state(N, window=window, body_words=1),
+        # server: echo backpressure ring + END bookkeeping (bidi)
+        eb_val=jnp.zeros((N, ECHO_RING), jnp.int32),
+        eb_w=jnp.zeros((N,), jnp.int32),
+        eb_r=jnp.zeros((N,), jnp.int32),
+        eb_cid=jnp.zeros((N,), jnp.int32),
+        eb_end=jnp.zeros((N,), jnp.int32),
+        # server: client-streaming aggregation
+        acc=jnp.zeros((N,), jnp.int32),
+        # server: server-streaming download pacing
+        dl_rem=jnp.zeros((N,), jnp.int32),
+        dl_next=jnp.zeros((N,), jnp.int32),
+        dl_cid=jnp.zeros((N,), jnp.int32),
+        dl_end=jnp.zeros((N,), jnp.int32),
+        # client
+        c_phase=z,      # 0 open, 1 push, 2 awaiting, 3 done
+        c_cid=z,
+        c_sent=z,
+        c_fin=z,        # our END went out
+        c_got=z,        # items received back
+        c_done=z,
+        c_prog=z,       # virtual time of last forward progress
+    )
+
+
+class StreamEchoServer(Service):
+    """All three streaming shapes behind @rpc_stream methods."""
+
+    def __init__(self, n_nodes: int, tick=ms(10)):
+        self.n = n_nodes
+        self.tick = tick
+
+    # ---- bidi echo: buffer delivered items, push them back paced --------
+    @rpc_stream
+    def echo(self, ctx: Ctx, st, src, kind, cid, body, when):
+        fresh = when & (kind == streaming.K_CALL)
+        # a new call resets the ring (a retried call replaces the old one)
+        for k in ("eb_w", "eb_r", "eb_end"):
+            st[k] = st[k].at[src].set(jnp.where(fresh, 0, st[k][src]))
+        st["eb_cid"] = st["eb_cid"].at[src].set(
+            jnp.where(fresh, cid, st["eb_cid"][src]))
+        item = when & (kind == streaming.K_ITEM) & (cid == st["eb_cid"][src])
+        wslot = st["eb_w"][src] % ECHO_RING
+        st["eb_val"] = st["eb_val"].at[src, wslot].set(
+            jnp.where(item, body[0], st["eb_val"][src, wslot]))
+        st["eb_w"] = st["eb_w"].at[src].set(st["eb_w"][src] + item)
+        st["eb_end"] = st["eb_end"].at[src].set(
+            st["eb_end"][src]
+            | (when & (kind == streaming.K_END)
+               & (cid == st["eb_cid"][src])))
+
+    # ---- client-streaming sum: aggregate, reply on END ------------------
+    @rpc_stream
+    def sum(self, ctx: Ctx, st, src, kind, cid, body, when):
+        st["acc"] = st["acc"].at[src].set(
+            jnp.where(when & (kind == streaming.K_CALL), 0,
+                      st["acc"][src]
+                      + jnp.where(when & (kind == streaming.K_ITEM),
+                                  body[0], 0)))
+        streaming.reply(ctx, st, src, cid, [st["acc"][src]],
+                        method=StreamEchoServer.sum.tag,
+                        when=when & (kind == streaming.K_END))
+
+    # ---- server-streaming download: K_CALL asks for n items -------------
+    @rpc_stream
+    def download(self, ctx: Ctx, st, src, kind, cid, body, when):
+        fresh = when & (kind == streaming.K_CALL)
+        st["dl_rem"] = st["dl_rem"].at[src].set(
+            jnp.where(fresh, body[0], st["dl_rem"][src]))
+        st["dl_next"] = st["dl_next"].at[src].set(
+            jnp.where(fresh, 0, st["dl_next"][src]))
+        st["dl_cid"] = st["dl_cid"].at[src].set(
+            jnp.where(fresh, cid, st["dl_cid"][src]))
+        st["dl_end"] = st["dl_end"].at[src].set(
+            jnp.where(fresh, 0, st["dl_end"][src]))
+
+    def _drain(self, ctx: Ctx, st):
+        """Paced response streaming: ≤1 echo item + ≤1 download item per
+        client per tick, window permitting (backpressure-correct — a full
+        send window delays, never drops)."""
+        for c in range(1, self.n):
+            # bidi echo ring
+            has = st["eb_r"][c] < st["eb_w"][c]
+            rslot = st["eb_r"][c] % ECHO_RING
+            ok = streaming.push(ctx, st, c, st["eb_cid"][c],
+                                [st["eb_val"][c, rslot]],
+                                method=StreamEchoServer.echo.tag, when=has)
+            st["eb_r"] = st["eb_r"].at[c].set(st["eb_r"][c] + ok)
+            drained = (st["eb_end"][c] == 1) & (st["eb_r"][c]
+                                                >= st["eb_w"][c])
+            fin = streaming.finish(ctx, st, c, st["eb_cid"][c],
+                                   method=StreamEchoServer.echo.tag,
+                                   when=drained)
+            st["eb_end"] = st["eb_end"].at[c].set(
+                jnp.where(fin, 0, st["eb_end"][c]))
+            # download stream
+            dhas = st["dl_rem"][c] > 0
+            dok = streaming.push(ctx, st, c, st["dl_cid"][c],
+                                 [st["dl_next"][c]],
+                                 method=StreamEchoServer.download.tag,
+                                 when=dhas)
+            st["dl_next"] = st["dl_next"].at[c].set(st["dl_next"][c] + dok)
+            st["dl_rem"] = st["dl_rem"].at[c].set(st["dl_rem"][c] - dok)
+            last = dok & (st["dl_rem"][c] == 0)
+            st["dl_end"] = st["dl_end"].at[c].set(
+                st["dl_end"][c] | last)
+            dfin = streaming.finish(ctx, st, c, st["dl_cid"][c],
+                                    method=StreamEchoServer.download.tag,
+                                    when=st["dl_end"][c] == 1)
+            st["dl_end"] = st["dl_end"].at[c].set(
+                jnp.where(dfin, 0, st["dl_end"][c]))
+
+    def init(self, ctx: Ctx):
+        st = dict(ctx.state)
+        conn.listen(ctx, st)
+        ctx.set_timer(self.tick, T_TICK, [0])
+        ctx.state = st
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        is_tick = tag == T_TICK
+        self._drain(ctx, st)
+        streaming.tick(ctx, st, range(1, self.n), when=is_tick)
+        ctx.set_timer(self.tick, T_TICK, [0], when=is_tick)
+        ctx.state = st
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        # connection lifecycle first: a (re)connecting or resetting client
+        # restarts the sequence space on BOTH sides — without this, a
+        # client-side reset after a mere connectivity gap (server alive)
+        # would desynchronize the windows forever
+        from ..utils.maskutil import needed
+        st = dict(ctx.state)
+        accept, _, rst = conn.on_message(ctx, st, src, tag)
+        fresh = accept | rst
+        if needed(fresh):
+            streaming.reset_peer(st, src, when=fresh)
+            for k in ("eb_w", "eb_r", "eb_end", "acc", "dl_rem", "dl_end"):
+                st[k] = st[k].at[src].set(jnp.where(fresh, 0, st[k][src]))
+        ctx.state = st
+        super().on_message(ctx, src, tag, payload)
+        # ACKs open send-window room: drain immediately, don't wait a tick
+        st = dict(ctx.state)
+        self._drain(ctx, st)
+        ctx.state = st
+
+
+class StreamEchoClient(Program):
+    """Drives one call of the configured shape to completion, verifying
+    every frame; stalls (kill-mid-stream, lost END) trigger a full
+    reconnect-and-retry with a fresh call id."""
+
+    def __init__(self, mode: str, n_items: int = 6, tick=ms(10),
+                 stall=ms(200)):
+        assert mode in ("bidi", "sum", "download")
+        self.mode = mode
+        self.n = n_items
+        self.tick = tick
+        self.stall = stall
+        self.method = dict(
+            bidi=StreamEchoServer.echo.tag,
+            sum=StreamEchoServer.sum.tag,
+            download=StreamEchoServer.download.tag)[mode]
+
+    def _value(self, ctx, i):
+        return ctx.node * 1000 + i * 7
+
+    def init(self, ctx: Ctx):
+        st = dict(ctx.state)
+        st["c_cid"] = ctx.randint(1, 2**30 - 1)
+        st["c_prog"] = ctx.now
+        ctx.set_timer(ctx.randint(0, self.tick), T_TICK, [0])
+        ctx.state = st
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        is_tick = tag == T_TICK
+        done = st["c_done"] == 1
+
+        # stall watchdog: tear the CONNECTION down (notifying a live
+        # server so it resets its side too), then re-issue from scratch
+        stalled = (is_tick & ~done
+                   & (ctx.now - st["c_prog"] > self.stall))
+        conn.reset(ctx, st, SERVER, when=stalled)
+        streaming.reset_peer(st, SERVER, when=stalled)
+        st["c_cid"] = jnp.where(stalled, ctx.randint(1, 2**30 - 1),
+                                st["c_cid"])
+        for k in ("c_sent", "c_fin", "c_got"):
+            st[k] = jnp.where(stalled, 0, st[k])
+        st["c_phase"] = jnp.where(stalled, 0, st["c_phase"])
+        st["c_prog"] = jnp.where(stalled, ctx.now, st["c_prog"])
+
+        # phase 0: connect, then open the call
+        est = conn.is_established(st, SERVER)
+        conn.connect(ctx, st, SERVER,
+                     when=is_tick & ~done & (st["c_phase"] == 0) & ~est)
+        opening = is_tick & ~done & (st["c_phase"] == 0) & est
+        open_body = [self.n] if self.mode == "download" else [0]
+        ok = streaming.open_call(ctx, st, SERVER, self.method, st["c_cid"],
+                                 open_body, when=opening)
+        st["c_phase"] = jnp.where(
+            ok, 2 if self.mode == "download" else 1, st["c_phase"])
+        st["c_prog"] = jnp.where(ok, ctx.now, st["c_prog"])
+
+        # phase 1: push request items, then our END
+        if self.mode in ("bidi", "sum"):
+            pushing = is_tick & ~done & (st["c_phase"] == 1) & (
+                st["c_sent"] < self.n)
+            pok = streaming.push(ctx, st, SERVER, st["c_cid"],
+                                 [self._value(ctx, st["c_sent"])],
+                                 method=self.method, when=pushing)
+            st["c_sent"] = st["c_sent"] + pok
+            fin_w = (is_tick & ~done & (st["c_phase"] == 1)
+                     & (st["c_sent"] >= self.n) & (st["c_fin"] == 0))
+            fok = streaming.finish(ctx, st, SERVER, st["c_cid"],
+                                   method=self.method, when=fin_w)
+            st["c_fin"] = st["c_fin"] + fok
+            st["c_phase"] = jnp.where(fok, 2, st["c_phase"])
+            st["c_prog"] = jnp.where(pok | fok, ctx.now, st["c_prog"])
+
+        streaming.tick(ctx, st, [SERVER], when=is_tick)
+        ctx.set_timer(self.tick, T_TICK, [0], when=is_tick)
+        ctx.state = st
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        from ..net.stream import delivered_slots
+        from ..utils.maskutil import needed
+        st = dict(ctx.state)
+        _, _, rst = conn.on_message(ctx, st, src, tag)
+        # server reset our connection: start over (fresh call id next tick)
+        if needed(rst):
+            streaming.reset_peer(st, SERVER, when=rst)
+            for k in ("c_sent", "c_fin", "c_got"):
+                st[k] = jnp.where(rst, 0, st[k])
+            st["c_phase"] = jnp.where(rst, 0, st["c_phase"])
+        kinds, methods, cids, bodies, mask = streaming.on_stream(
+            ctx, st, src, tag, payload)
+        for i in delivered_slots(mask):
+            mine = (mask[i] & (src == SERVER) & (cids[i] == st["c_cid"])
+                    & (st["c_done"] == 0))
+            item = mine & (kinds[i] == streaming.K_ITEM)
+            end = mine & (kinds[i] == streaming.K_END)
+            repl = mine & (kinds[i] == streaming.K_REPLY)
+            if self.mode == "bidi":
+                # echoed values come back exactly once, in order
+                ctx.crash_if(
+                    item & (bodies[i][0]
+                            != self._value(ctx, st["c_got"])),
+                    CRASH_BAD_ECHO)
+                st["c_got"] = st["c_got"] + item
+                got_all = end & (st["c_got"] >= self.n)
+                ctx.crash_if(end & (st["c_got"] < self.n), CRASH_BAD_ECHO)
+                st["c_done"] = jnp.where(got_all, 1, st["c_done"])
+            elif self.mode == "sum":
+                expect = sum(ctx.node * 1000 + i * 7 for i in range(self.n))
+                ctx.crash_if(repl & (bodies[i][0] != expect), CRASH_BAD_SUM)
+                st["c_done"] = jnp.where(repl, 1, st["c_done"])
+            else:  # download
+                ctx.crash_if(item & (bodies[i][0] != st["c_got"]),
+                             CRASH_BAD_DOWNLOAD)
+                st["c_got"] = st["c_got"] + item
+                ctx.crash_if(end & (st["c_got"] < self.n),
+                             CRASH_BAD_DOWNLOAD)
+                st["c_done"] = jnp.where(end & (st["c_got"] >= self.n), 1,
+                                         st["c_done"])
+            st["c_prog"] = jnp.where(mine, ctx.now, st["c_prog"])
+        ctx.state = st
+
+
+def clients_done(n_nodes: int):
+    def check(state):
+        return (state.node_state["c_done"][1:n_nodes] == 1).all()
+    return check
+
+
+def make_stream_echo_runtime(mode: str, n_clients: int = 2, n_items: int = 6,
+                             scenario=None, cfg=None):
+    from ..core.types import NetConfig, SimConfig, sec
+    from ..runtime.runtime import Runtime
+    n = 1 + n_clients
+    if cfg is None:
+        cfg = SimConfig(n_nodes=n, event_capacity=256, payload_words=8,
+                        time_limit=sec(10),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(8)))
+    assert cfg.payload_words >= 1 + streaming.HEADER_WORDS + 1
+    server = StreamEchoServer(n)
+    client = StreamEchoClient(mode, n_items)
+    node_prog = np.asarray([0] + [1] * n_clients, np.int32)
+    return Runtime(cfg, [server, client], echo_state_spec(n),
+                   node_prog=node_prog, scenario=scenario,
+                   halt_when=clients_done(n))

@@ -46,6 +46,9 @@ def connect(ctx: Ctx, st, dst, *, when=True):
     """Initiate a handshake (TcpStream::connect). Completion is observed
     via is_established once the SYN-ACK returns; pair with a retry timer
     for lossy networks."""
+    from ..utils.maskutil import statically_false
+    if statically_false(when):
+        return jnp.asarray(False)
     dst = jnp.asarray(dst, jnp.int32)
     # dialing is idempotent from SYN_SENT so a retry timer can re-send a
     # lost SYN (the reference's connect retries inside try_send)
@@ -66,6 +69,11 @@ def on_message(ctx: Ctx, st, src, tag):
     (accepted, established, reset) masks for this event. Call before
     stream.on_message; data for CLOSED peers should be ignored by the app.
     """
+    from ..utils.maskutil import statically_false
+    if statically_false((tag == TAG_SYN) | (tag == TAG_SYN_ACK)
+                        | (tag == TAG_RST)):
+        f = jnp.asarray(False)
+        return f, f, f
     src = jnp.asarray(src, jnp.int32)
 
     # listener side: SYN while listening -> ESTABLISHED + SYN-ACK;
@@ -93,6 +101,9 @@ def on_message(ctx: Ctx, st, src, tag):
 
 def reset(ctx: Ctx, st, peer, *, when=True):
     """Abort a connection and notify the peer (the reset-on-close path)."""
+    from ..utils.maskutil import statically_false
+    if statically_false(when):
+        return
     peer = jnp.asarray(peer, jnp.int32)
     w = jnp.asarray(when) & (st["cn_state"][peer] != CLOSED)
     st["cn_state"] = st["cn_state"].at[peer].set(

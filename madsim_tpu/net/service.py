@@ -44,6 +44,22 @@ def rpc(fn):
     return fn
 
 
+def rpc_stream(fn):
+    """Mark a Service method as a STREAMING handler (the tonic
+    client/server/bidi-streaming shapes, madsim-tonic client.rs:52-124).
+
+    Called once per frame delivered by the reliable stream fabric, with
+    (ctx, st, src, kind, call_id, body, when); kind is streaming.K_CALL /
+    K_ITEM / K_END. The method consumes the request stream frame-by-frame
+    and produces its response (stream) with streaming.push/finish/reply.
+    Senders must pass `method=Method.tag` on every frame so dispatch works
+    on items, not just the opening call.
+    """
+    fn._rpc_stream_tag = _hash33(fn.__qualname__) % (1 << 29)
+    fn.tag = fn._rpc_stream_tag
+    return fn
+
+
 class Service(Program):
     """Base class dispatching tagged requests to @rpc methods and sending
     replies with the net.rpc call-id convention."""
@@ -58,6 +74,19 @@ class Service(Program):
         tags = [m._rpc_tag for m in hs]
         assert len(set(tags)) == len(tags), (
             f"@rpc tag hash collision in {type(self).__name__}: "
+            f"{[m.__qualname__ for m in hs]} — rename a method")
+        return hs
+
+    def _stream_handlers(self):
+        hs = []
+        for name in dir(type(self)):
+            m = getattr(type(self), name)
+            if callable(m) and hasattr(m, "_rpc_stream_tag"):
+                hs.append(m)
+        hs.sort(key=lambda m: m._rpc_stream_tag)
+        tags = [m._rpc_stream_tag for m in hs]
+        assert len(set(tags)) == len(tags), (
+            f"@rpc_stream tag hash collision in {type(self).__name__}: "
             f"{[m.__qualname__ for m in hs]} — rename a method")
         return hs
 
@@ -85,4 +114,22 @@ class Service(Program):
                 merged_body[i] = jnp.where(when, wd, merged_body[i])
         ctx.send(src, _rpc.reply_tag(merged_tag),
                  [payload[0]] + merged_body, when=merged_when)
+
+        # ---- streaming methods: dispatch each frame the reliable stream
+        # fabric delivers this event (requires streaming_state fields in
+        # the service's state spec)
+        shs = self._stream_handlers()
+        if shs:
+            assert "sx_val" in st, (
+                f"{type(self).__name__} has @rpc_stream methods but its "
+                "state spec lacks streaming_state(...) fields — frames "
+                "would be silently ignored")
+            from . import stream as _stream
+            from . import streaming
+            kinds, methods, cids, bodies_f, mask = streaming.on_stream(
+                ctx, st, src, tag, payload)
+            for i in _stream.delivered_slots(mask):
+                for m in shs:
+                    m(self, ctx, st, src, kinds[i], cids[i], bodies_f[i],
+                      mask[i] & (methods[i] == m._rpc_stream_tag))
         ctx.state = st

@@ -30,16 +30,23 @@ TAG_DATA = 1 << 20
 TAG_ACK = (1 << 20) + 1
 
 
-def stream_state(n_nodes: int, window: int = 4):
-    """Per-node stream state: one bidirectional stream per peer."""
-    N, W = n_nodes, window
+def stream_state(n_nodes: int, window: int = 4, item_words: int = 1):
+    """Per-node stream state: one bidirectional stream per peer.
+
+    item_words > 1 makes each stream element a fixed int32 vector instead of
+    a scalar (the framed-message case: streaming RPC items, file chunks) —
+    rings gain a trailing [item_words] axis and send/on_message move whole
+    vectors. Requires payload_words >= 1 + item_words.
+    """
+    N, W, V = n_nodes, window, item_words
     z = jnp.zeros((N,), jnp.int32)
+    shape = (N, W) if V == 1 else (N, W, V)
     return dict(
         sx_seq=z,                                  # next seq to assign (tx)
         sx_base=z,                                 # lowest unacked seq
-        sx_val=jnp.zeros((N, W), jnp.int32),       # unacked ring
+        sx_val=jnp.zeros(shape, jnp.int32),        # unacked ring
         sr_next=z,                                 # next expected seq (rx)
-        sr_val=jnp.zeros((N, W), jnp.int32),       # out-of-order ring
+        sr_val=jnp.zeros(shape, jnp.int32),        # out-of-order ring
         sr_have=jnp.zeros((N, W), bool),
     )
 
@@ -48,12 +55,40 @@ def _window(st):
     return st["sr_have"].shape[1]
 
 
+def _item_words(st):
+    v = st["sx_val"]
+    return 1 if v.ndim == 2 else v.shape[2]
+
+
+def _as_item(val, V):
+    """Coerce a scalar / list / vector into the stream's item shape."""
+    if V == 1:
+        return jnp.asarray(val, jnp.int32)
+    if isinstance(val, (list, tuple)):
+        items = [jnp.asarray(x, jnp.int32) for x in val]
+        items += [jnp.zeros((), jnp.int32)] * (V - len(items))
+        return jnp.stack(items)
+    val = jnp.asarray(val, jnp.int32)
+    assert val.shape == (V,), f"stream item must be ({V},), got {val.shape}"
+    return val
+
+
+def _data_payload(seq, item, V):
+    if V == 1:
+        return [seq, item]
+    return jnp.concatenate([jnp.stack([seq]), item])
+
+
 def send(ctx: Ctx, st, dst, val, *, when=True):
     """Enqueue one value on the stream to `dst` and transmit it. Refused
     (returns False mask) when the send window is full — like a TCP write
     blocking on a full buffer (stream.rs:185-209)."""
-    W = _window(st)
+    from ..utils.maskutil import statically_false
+    if statically_false(when):
+        return jnp.asarray(False)
+    W, V = _window(st), _item_words(st)
     dst = jnp.asarray(dst, jnp.int32)
+    val = _as_item(val, V)
     seq = st["sx_seq"][dst]
     room = (seq - st["sx_base"][dst]) < W
     ok = jnp.asarray(when) & room
@@ -61,64 +96,124 @@ def send(ctx: Ctx, st, dst, val, *, when=True):
     st["sx_val"] = st["sx_val"].at[dst, slot].set(
         jnp.where(ok, val, st["sx_val"][dst, slot]))
     st["sx_seq"] = st["sx_seq"].at[dst].set(seq + ok)
-    ctx.send(dst, TAG_DATA, [seq, val], when=ok)
+    ctx.send(dst, TAG_DATA, _data_payload(seq, val, V), when=ok)
     return ok
 
 
 def retransmit(ctx: Ctx, st, dst, *, when=True):
     """Resend every unacked value to `dst` (cumulative-ack Go-Back-N).
     Arm a periodic timer and call this on fire."""
-    W = _window(st)
+    from ..utils.maskutil import statically_false
+    if statically_false(when):
+        return
+    W, V = _window(st), _item_words(st)
     dst = jnp.asarray(dst, jnp.int32)
     base, nxt = st["sx_base"][dst], st["sx_seq"][dst]
     for i in range(W):
         seq = base + i
         live = jnp.asarray(when) & (seq < nxt)
-        ctx.send(dst, TAG_DATA, [seq, st["sx_val"][dst, seq % W]], when=live)
+        if statically_false(live):
+            continue
+        ctx.send(dst, TAG_DATA,
+                 _data_payload(seq, st["sx_val"][dst, seq % W], V),
+                 when=live)
+
+
+def delivered_slots(mask):
+    """Iteration helper for the per-event delivery loop.
+
+    Under jit/vmap (the simulator) `mask` is a tracer, so every slot must
+    be visited with masked ops — that's the fixed-shape discipline. In the
+    real-world runtime (real/runtime.py) values are concrete and almost
+    every slot is empty; visiting only the delivered ones keeps eager
+    dispatch cost proportional to actual traffic. Call sites are identical
+    in both worlds.
+    """
+    import jax
+
+    if isinstance(mask, jax.core.Tracer):
+        return range(mask.shape[0])
+    import numpy as np
+
+    return np.nonzero(np.asarray(mask))[0].tolist()
+
+
+def reset_peer(st, peer, *, when=True):
+    """Wipe both directions of the stream to `peer` (fresh sequence space).
+    Pair with conn-layer reset/reconnect: a restarted peer lost its stream
+    state, so the survivor must restart the sequence space too — exactly a
+    new TCP connection after the old one died (stream.rs:162-209)."""
+    from ..utils.maskutil import statically_false
+    if statically_false(when):
+        return
+    peer = jnp.asarray(peer, jnp.int32)
+    w = jnp.asarray(when)
+    z = jnp.zeros((), jnp.int32)
+    for k in ("sx_seq", "sx_base", "sr_next"):
+        st[k] = st[k].at[peer].set(jnp.where(w, z, st[k][peer]))
+    st["sx_val"] = st["sx_val"].at[peer].set(
+        jnp.where(w, 0, st["sx_val"][peer]))
+    st["sr_val"] = st["sr_val"].at[peer].set(
+        jnp.where(w, 0, st["sr_val"][peer]))
+    st["sr_have"] = st["sr_have"].at[peer].set(
+        jnp.where(w, False, st["sr_have"][peer]))
 
 
 def on_message(ctx: Ctx, st, src, tag, payload):
     """Feed a received message through the stream layer.
 
     Returns (vals, mask): up to `window` values newly deliverable IN ORDER
-    (mask[i] marks validity; process them with masked ops). Non-stream tags
-    return an all-False mask — safe to call unconditionally.
+    (mask[i] marks validity; process them with masked ops). vals has shape
+    [window] for scalar streams, [window, item_words] for vector streams.
+    Non-stream tags return an all-False mask — safe to call unconditionally.
     """
-    W = _window(st)
+    from ..utils.maskutil import statically_false
+    W, V = _window(st), _item_words(st)
+    if statically_false((tag == TAG_DATA) | (tag == TAG_ACK)):
+        shape = (W,) if V == 1 else (W, V)
+        return jnp.zeros(shape, jnp.int32), jnp.zeros((W,), bool)
+    from ..utils.maskutil import needed
     src = jnp.asarray(src, jnp.int32)
 
     # ---- DATA: buffer in-window segments, deliver the contiguous run ----
     is_data = tag == TAG_DATA
-    seq, val = payload[0], payload[1]
-    nxt = st["sr_next"][src]
-    in_win = is_data & (seq >= nxt) & (seq < nxt + W)
-    slot = seq % W
-    st["sr_val"] = st["sr_val"].at[src, slot].set(
-        jnp.where(in_win, val, st["sr_val"][src, slot]))
-    st["sr_have"] = st["sr_have"].at[src, slot].set(
-        st["sr_have"][src, slot] | in_win)
+    if needed(is_data):
+        seq = payload[0]
+        val = payload[1] if V == 1 else payload[1:1 + V]
+        nxt = st["sr_next"][src]
+        in_win = is_data & (seq >= nxt) & (seq < nxt + W)
+        slot = seq % W
+        st["sr_val"] = st["sr_val"].at[src, slot].set(
+            jnp.where(in_win, val, st["sr_val"][src, slot]))
+        st["sr_have"] = st["sr_have"].at[src, slot].set(
+            st["sr_have"][src, slot] | in_win)
 
-    # longest contiguous run starting at sr_next (exactly-once, in-order)
-    offs = jnp.arange(W, dtype=jnp.int32)
-    have_seq = st["sr_have"][src, (nxt + offs) % W]
-    run = jnp.cumprod(have_seq.astype(jnp.int32))      # 1,1,..,0,..
-    count = run.sum()
-    deliver = is_data & (run == 1)
-    vals = st["sr_val"][src, (nxt + offs) % W]
-    # clear delivered slots, advance the window
-    st["sr_have"] = st["sr_have"].at[src, (nxt + offs) % W].set(
-        jnp.where(deliver, False, st["sr_have"][src, (nxt + offs) % W]))
-    st["sr_next"] = st["sr_next"].at[src].set(
-        nxt + jnp.where(is_data, count, 0))
-    # cumulative ack (also for duplicates below the window — re-ack)
-    ctx.send(src, TAG_ACK, [st["sr_next"][src]], when=is_data)
+        # longest contiguous run starting at sr_next (exactly-once, in-order)
+        offs = jnp.arange(W, dtype=jnp.int32)
+        have_seq = st["sr_have"][src, (nxt + offs) % W]
+        run = jnp.cumprod(have_seq.astype(jnp.int32))      # 1,1,..,0,..
+        count = run.sum()
+        deliver = is_data & (run == 1)
+        vals = st["sr_val"][src, (nxt + offs) % W]
+        # clear delivered slots, advance the window
+        st["sr_have"] = st["sr_have"].at[src, (nxt + offs) % W].set(
+            jnp.where(deliver, False, st["sr_have"][src, (nxt + offs) % W]))
+        st["sr_next"] = st["sr_next"].at[src].set(
+            nxt + jnp.where(is_data, count, 0))
+        # cumulative ack (also for duplicates below the window — re-ack)
+        ctx.send(src, TAG_ACK, [st["sr_next"][src]], when=is_data)
+    else:
+        shape = (W,) if V == 1 else (W, V)
+        vals = jnp.zeros(shape, jnp.int32)
+        deliver = jnp.zeros((W,), bool)
 
     # ---- ACK: slide the send window ------------------------------------
     is_ack = tag == TAG_ACK
-    cum = payload[0]
-    st["sx_base"] = st["sx_base"].at[src].set(
-        jnp.where(is_ack,
-                  jnp.clip(cum, st["sx_base"][src], st["sx_seq"][src]),
-                  st["sx_base"][src]))
+    if needed(is_ack):
+        cum = payload[0]
+        st["sx_base"] = st["sx_base"].at[src].set(
+            jnp.where(is_ack,
+                      jnp.clip(cum, st["sx_base"][src], st["sx_seq"][src]),
+                      st["sx_base"][src]))
 
     return vals, deliver

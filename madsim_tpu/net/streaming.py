@@ -1,0 +1,112 @@
+"""Streaming RPC — the madsim-tonic analog (all four gRPC method shapes).
+
+The reference simulates tonic by sending each stream item as its own tagged
+message and marking termination with a `StreamEnd` sentinel
+(madsim-tonic/src/client.rs:52-124 drives unary / client-streaming /
+server-streaming / bidi through one code path; codec.rs:30-45 encodes the
+end marker). Here the same framing rides the RELIABLE ordered stream layer
+(net/stream.py with vector items), so streaming calls survive the lossy
+reordering datagram fabric the way tonic calls survive TCP:
+
+  frame = [kind, method_tag, call_id, *body]
+    kind: K_CALL (open, carries the request or stream header)
+          K_ITEM (one stream element, either direction)
+          K_END  (StreamEnd marker)
+          K_REPLY (unary/final response)
+
+Call ids are random per call (net/rpc.py convention); items of concurrent
+calls interleave on one peer-stream and demux by call_id. Delivery is
+exactly-once in-order per peer, so seq numbers and dedup come for free from
+the transport — what the reference gets from tonic-over-sim-TCP.
+
+Shapes (client.rs:52-124 parity):
+  unary            open(K_CALL+body) ......... reply(K_REPLY+body)
+  client-streaming open, push*, finish ....... reply(K_REPLY aggregate)
+  server-streaming open(request) ............. push*, finish
+  bidi             open, push*, finish ....... push* (echo pacing), finish
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.api import Ctx
+from . import stream
+
+K_CALL, K_ITEM, K_END, K_REPLY = 1, 2, 3, 4
+
+HEADER_WORDS = 3  # kind, method_tag, call_id
+
+
+def streaming_state(n_nodes: int, window: int = 4, body_words: int = 2):
+    """Stream-fabric state sized for framed RPC items. Requires
+    cfg.payload_words >= 1 + HEADER_WORDS + body_words (seq + frame)."""
+    return stream.stream_state(n_nodes, window,
+                               item_words=HEADER_WORDS + body_words)
+
+
+def body_width(st) -> int:
+    return st["sx_val"].shape[2] - HEADER_WORDS
+
+
+def _frame(kind, method, call_id, body, V):
+    kind = jnp.asarray(kind, jnp.int32)
+    words = [kind, jnp.asarray(method, jnp.int32),
+             jnp.asarray(call_id, jnp.int32)]
+    words += [jnp.asarray(b, jnp.int32) for b in body]
+    assert len(words) <= V, f"frame ({len(words)} words) exceeds item ({V})"
+    return words
+
+
+def open_call(ctx: Ctx, st, dst, method, call_id, body=(), *, when=True):
+    """Start a call (any shape): K_CALL carries the unary request or the
+    stream header. Returns ok mask (False = send window full, try again)."""
+    V = st["sx_val"].shape[2]
+    return stream.send(ctx, st, dst,
+                       _frame(K_CALL, method, call_id, body, V), when=when)
+
+
+def push(ctx: Ctx, st, dst, call_id, body=(), *, method=0, when=True):
+    """Send one stream item on an open call (either direction)."""
+    V = st["sx_val"].shape[2]
+    return stream.send(ctx, st, dst,
+                       _frame(K_ITEM, method, call_id, body, V), when=when)
+
+
+def finish(ctx: Ctx, st, dst, call_id, *, method=0, when=True):
+    """Send the StreamEnd marker (codec.rs:30-45)."""
+    V = st["sx_val"].shape[2]
+    return stream.send(ctx, st, dst,
+                       _frame(K_END, method, call_id, (), V), when=when)
+
+
+def reply(ctx: Ctx, st, dst, call_id, body=(), *, method=0, when=True):
+    """Send the unary / aggregate response for a call."""
+    V = st["sx_val"].shape[2]
+    return stream.send(ctx, st, dst,
+                       _frame(K_REPLY, method, call_id, body, V), when=when)
+
+
+def on_stream(ctx: Ctx, st, src, tag, payload):
+    """Feed a received message through transport + framing.
+
+    Returns (kinds[W], methods[W], call_ids[W], bodies[W, B], mask[W]):
+    the frames newly deliverable IN ORDER this event. Safe to call
+    unconditionally; non-stream tags yield an all-False mask.
+    """
+    vals, mask = stream.on_message(ctx, st, src, tag, payload)
+    return (vals[:, 0], vals[:, 1], vals[:, 2],
+            vals[:, HEADER_WORDS:], mask)
+
+
+def tick(ctx: Ctx, st, peers, *, when=True):
+    """Retransmit unacked frames to each peer (arm a periodic timer and
+    call this on fire — the transport's Go-Back-N driver)."""
+    for p in peers:
+        stream.retransmit(ctx, st, p, when=when)
+
+
+def reset_peer(st, peer, *, when=True):
+    """Tear down the stream fabric to a (restarted) peer — outstanding
+    calls die with the connection, as when a tonic channel breaks."""
+    stream.reset_peer(st, peer, when=when)

@@ -1,0 +1,39 @@
+"""Mask utilities shared by the dual-world effect helpers.
+
+The simulator's discipline is masked ops: every effect call executes with a
+`when` mask so the traced program has static shape. Under jit that's free —
+XLA sees one fused program. In the real-world runtime (real/runtime.py)
+handlers run EAGERLY, where a masked no-op still costs a dispatch; with
+protocol libraries doing W-wide window loops that adds up to tens of ms per
+event. `statically_false(mask)` lets effect helpers skip work when the mask
+is CONCRETELY all-False: tracers never short-circuit (simulation semantics
+untouched), concrete falses cost one host check instead of a jnp op chain.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def needed(mask) -> bool:
+    """Guard for a masked block of handler logic: always True under
+    tracing (the block is part of the compiled program), False eagerly
+    when the mask is concretely all-False (skip the dead branch). Lets a
+    protocol handler keep ONE code path while the real-world runtime pays
+    only for the branch that actually fires."""
+    return not statically_false(mask)
+
+
+def statically_false(mask) -> bool:
+    """True iff `mask` is a concrete (non-tracer) value that is all-False —
+    i.e. this effect provably does nothing and may be skipped eagerly."""
+    if isinstance(mask, jax.core.Tracer):
+        return False
+    if isinstance(mask, bool):
+        return not mask
+    try:
+        import numpy as np
+
+        return not bool(np.asarray(mask).any())
+    except Exception:
+        return False
