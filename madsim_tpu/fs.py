@@ -1,0 +1,111 @@
+"""Simulated per-node filesystem with sync-gated durability — the FsSim
+analog (sim/fs.rs:154-246), power-fail semantics included.
+
+The reference models files as in-memory buffers with `read_at /
+write_all_at / set_len / sync_all`, and left "power failure" — losing
+writes that were never synced — as a TODO (fs.rs:48-51). Here that
+semantics falls out of the engine's stable-storage design: every file
+exists twice,
+
+  fs_mem  — the page-cache view: all writes land here; reads see them
+  fs_disk — the durable view: updated ONLY by sync_all
+
+and only `fs_disk`/`fs_dlen` go in the persist mask. A kill therefore
+drops the memory view on the floor (the engine resets volatile leaves),
+and `mount()` in the program's init restores it from disk — any write
+that wasn't synced before the kill is GONE. That's a real power-fail
+model, checked red/green by the WAL workload in models/wal_kv.py.
+
+All helpers are masked/traceable; files are fixed [n_files, file_words]
+int32 arrays per node (fixed shapes: the TPU discipline), addressed by
+static or traced file ids and dynamic word offsets.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["fs_state", "fs_persist", "mount", "read_at", "write_all_at",
+           "set_len", "sync_all", "file_len"]
+
+
+def fs_state(n_files: int, file_words: int):
+    """State-schema fragment: merge into your Program's state_spec."""
+    F, S = n_files, file_words
+    return dict(
+        fs_mem=jnp.zeros((F, S), jnp.int32),
+        fs_mlen=jnp.zeros((F,), jnp.int32),
+        fs_disk=jnp.zeros((F, S), jnp.int32),
+        fs_dlen=jnp.zeros((F,), jnp.int32),
+    )
+
+
+def fs_persist():
+    """Persist-mask fragment: ONLY the disk view survives kill/restart."""
+    return dict(fs_mem=False, fs_mlen=False, fs_disk=True, fs_dlen=True)
+
+
+def mount(st, *, when=True):
+    """Rebuild the memory view from disk — call in Program.init. After a
+    power-fail this is where unsynced writes are observably absent."""
+    w = jnp.asarray(when)
+    st["fs_mem"] = jnp.where(w, st["fs_disk"], st["fs_mem"])
+    st["fs_mlen"] = jnp.where(w, st["fs_dlen"], st["fs_mlen"])
+
+
+def file_len(st, f):
+    """Current (memory-view) length in words (fs.rs metadata analog)."""
+    return st["fs_mlen"][f]
+
+
+def read_at(st, f, offset, width: int):
+    """Read `width` words at `offset` (static width, dynamic offset) from
+    the memory view — reads observe unsynced writes, as with a page cache
+    (fs.rs:154-177). Words beyond the file length read as 0."""
+    S = st["fs_mem"].shape[1]
+    idx = jnp.asarray(offset, jnp.int32) + jnp.arange(width, dtype=jnp.int32)
+    vals = st["fs_mem"][f, jnp.clip(idx, 0, S - 1)]
+    return jnp.where((idx < st["fs_mlen"][f]) & (idx < S), vals, 0)
+
+
+def write_all_at(st, f, offset, words, *, when=True):
+    """Write a word vector at `offset` into the MEMORY view
+    (fs.rs:179-207 write_all_at): durable only after sync_all. Returns the
+    ok mask (False if the write would overrun the fixed file capacity —
+    the disk-full analog)."""
+    S = st["fs_mem"].shape[1]
+    words = jnp.atleast_1d(jnp.asarray(words, jnp.int32))
+    width = words.shape[0]
+    offset = jnp.asarray(offset, jnp.int32)
+    ok = jnp.asarray(when) & (offset >= 0) & (offset + width <= S)
+    idx = jnp.clip(offset + jnp.arange(width, dtype=jnp.int32), 0, S - 1)
+    st["fs_mem"] = st["fs_mem"].at[f, idx].set(
+        jnp.where(ok, words, st["fs_mem"][f, idx]))
+    st["fs_mlen"] = st["fs_mlen"].at[f].set(
+        jnp.where(ok, jnp.maximum(st["fs_mlen"][f], offset + width),
+                  st["fs_mlen"][f]))
+    return ok
+
+
+def set_len(st, f, new_len, *, when=True):
+    """Truncate/extend the memory view (fs.rs:209-227 set_len): shrinking
+    zeroes the dropped words, growing zero-fills — both only durable after
+    sync_all."""
+    S = st["fs_mem"].shape[1]
+    new_len = jnp.clip(jnp.asarray(new_len, jnp.int32), 0, S)
+    w = jnp.asarray(when)
+    ks = jnp.arange(S, dtype=jnp.int32)
+    st["fs_mem"] = st["fs_mem"].at[f].set(
+        jnp.where(w & (ks >= new_len), 0, st["fs_mem"][f]))
+    st["fs_mlen"] = st["fs_mlen"].at[f].set(
+        jnp.where(w, new_len, st["fs_mlen"][f]))
+
+
+def sync_all(st, f, *, when=True):
+    """Flush file `f`: disk view := memory view (fs.rs:229-246 sync_all).
+    The ONLY operation that makes writes survive a power-fail."""
+    w = jnp.asarray(when)
+    st["fs_disk"] = st["fs_disk"].at[f].set(
+        jnp.where(w, st["fs_mem"][f], st["fs_disk"][f]))
+    st["fs_dlen"] = st["fs_dlen"].at[f].set(
+        jnp.where(w, st["fs_mlen"][f], st["fs_dlen"][f]))

@@ -1,0 +1,97 @@
+"""Filesystem simulator: read_at/write_all_at/set_len/sync_all semantics and
+REAL power-fail — unsynced writes must die with the process (fs.rs:154-246;
+power-fail was TODO at fs.rs:48-51, here it is load-bearing and tested red).
+"""
+
+import numpy as np
+import pytest
+
+from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
+from madsim_tpu import fs
+from madsim_tpu.harness.simtest import SimFailure, run_seeds
+from madsim_tpu.models import wal_kv
+from madsim_tpu.models.wal_kv import make_wal_kv_runtime
+
+SEEDS = np.arange(8)
+
+
+class TestFileApi:
+    # the helpers are plain masked array ops — unit-testable without a sim
+    def test_write_read_roundtrip(self):
+        st = fs.fs_state(2, 16)
+        ok = fs.write_all_at(st, 0, 3, [7, 8, 9])
+        assert bool(ok)
+        assert fs.read_at(st, 0, 3, 3).tolist() == [7, 8, 9]
+        assert int(fs.file_len(st, 0)) == 6
+        assert int(fs.file_len(st, 1)) == 0          # other file untouched
+
+    def test_write_past_capacity_refused(self):
+        st = fs.fs_state(1, 8)
+        ok = fs.write_all_at(st, 0, 6, [1, 2, 3])    # would end at 9 > 8
+        assert not bool(ok)
+        assert int(fs.file_len(st, 0)) == 0
+
+    def test_set_len_truncates_and_zeroes(self):
+        st = fs.fs_state(1, 8)
+        fs.write_all_at(st, 0, 0, [1, 2, 3, 4])
+        fs.set_len(st, 0, 2)
+        assert int(fs.file_len(st, 0)) == 2
+        # the dropped words read as zero even if length grows back
+        fs.set_len(st, 0, 4)
+        assert fs.read_at(st, 0, 0, 4).tolist() == [1, 2, 0, 0]
+
+    def test_sync_gates_durability(self):
+        st = fs.fs_state(1, 8)
+        fs.write_all_at(st, 0, 0, [5, 6])
+        fs.sync_all(st, 0)
+        fs.write_all_at(st, 0, 2, [7])               # never synced
+        # power-fail: volatile view lost, remount from disk
+        st["fs_mem"] = np.zeros_like(st["fs_mem"])
+        st["fs_mlen"] = np.zeros_like(st["fs_mlen"])
+        fs.mount(st)
+        assert int(fs.file_len(st, 0)) == 2
+        assert fs.read_at(st, 0, 0, 3).tolist() == [5, 6, 0]
+
+
+def _chaos(n_rounds=4, first=ms(250), gap=ms(400), down=ms(120)):
+    sc = Scenario()
+    for t in range(n_rounds):
+        sc.at(first + gap * t).kill(wal_kv.SERVER)
+        sc.at(first + gap * t + down).restart(wal_kv.SERVER)
+    return sc
+
+
+class TestWalRecovery:
+    def test_synced_wal_survives_kill_chaos(self):
+        # acked writes keep their promise across repeated power-fails
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=8,
+                                 sync_wal=True, scenario=_chaos())
+        state = run_seeds(rt, SEEDS, max_steps=40_000)
+        done = np.asarray(state.node_state["c_done"])[:, 1:]
+        assert (done == 1).all()
+
+    def test_checkpoint_truncation_path(self):
+        # tiny WAL: every few PUTs checkpoint to the DB file and truncate —
+        # recovery must compose DB load + WAL replay correctly mid-chaos
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=16, wal_cap=3,
+                                 sync_wal=True, scenario=_chaos(5))
+        state = run_seeds(rt, SEEDS, max_steps=60_000)
+        done = np.asarray(state.node_state["c_done"])[:, 1:]
+        assert (done == 1).all()
+
+    def test_unsynced_wal_loses_acked_writes(self):
+        # remove the one sync between append and ack: with power-fail chaos
+        # the durability oracle MUST catch a lost acked write — this test
+        # flipping red is the proof the sync gate is real
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                                 sync_wal=False,
+                                 scenario=_chaos(6, first=ms(150),
+                                                 gap=ms(250), down=ms(60)))
+        with pytest.raises(SimFailure) as ei:
+            run_seeds(rt, np.arange(16), max_steps=60_000)
+        assert ei.value.code == wal_kv.CRASH_LOST_WRITE
+
+    def test_replay_stable(self):
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=8, wal_cap=4,
+                                 sync_wal=True, scenario=_chaos(2))
+        assert rt.check_determinism(seed=3, max_steps=20_000)
