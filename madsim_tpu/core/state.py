@@ -38,6 +38,9 @@ class SimState:
     crash_node: jax.Array   # int32 — node implicated, -1 if n/a
     oops: jax.Array         # int32 bitmask — capacity overflows
     steps: jax.Array        # int32 — events dispatched so far
+    tlimit: jax.Array       # int32 ticks — virtual-time limit; DYNAMIC (like
+                            # loss/latency) so set_time_limit / the
+                            # MADSIM_TEST_TIME_LIMIT env knob need no recompile
 
     # --- event table [C] --------------------------------------------------
     t_deadline: jax.Array   # int32[C] — fire time (T_INF when slot free)
@@ -86,6 +89,7 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         crash_node=jnp.asarray(-1, i32),
         oops=jnp.asarray(0, i32),
         steps=jnp.asarray(0, i32),
+        tlimit=jnp.asarray(cfg.time_limit, i32),
         t_deadline=jnp.full((C,), T.T_INF, i32),
         t_kind=jnp.zeros((C,), i32),
         t_node=jnp.zeros((C,), i32),

@@ -128,7 +128,7 @@ def make_step(
         now = jnp.where(valid, jnp.maximum(s.now, dmin), s.now)
         # strict >: the scenario's HALT op sits at exactly time_limit, and
         # same-deadline ties may dispatch before it without being late
-        time_over = now > jnp.asarray(cfg.time_limit, jnp.int32)
+        time_over = now > s.tlimit
         s = s.replace(
             key=key,
             now=now,

@@ -67,25 +67,27 @@ def env_net_override():
         return T.NetConfig.from_toml(f.read())
 
 
-def effective_config_hash(rt: Runtime, net_override=None) -> str:
-    """Repro hash covering BOTH the static config and any runtime net
-    override — the printed hash must identify the config that actually ran
+def effective_config_hash(rt: Runtime, net_override=None,
+                          time_limit_override=None) -> str:
+    """Repro hash covering the static config and any runtime overrides —
+    the printed hash must identify the config that actually ran
     (the config.rs:27-31 contract)."""
     h = rt.cfg.hash()
-    if net_override is None:
+    if net_override is None and not time_limit_override:
         return h
-    import hashlib
-    blob = f"{h}|{net_override}".encode()
+    blob = f"{h}|{net_override}|{time_limit_override}".encode()
     return hashlib.sha256(blob).hexdigest()[:8]
 
 
 def run_seeds(rt: Runtime, seeds, max_steps: int, chunk: int = 512,
-              net_override=None):
+              net_override=None, time_limit_override=None):
     """Run a seed batch to completion; raise SimFailure on the first crashed
     seed (lowest index). Returns the final batched state."""
     init = apply_net_override(rt.init_batch(np.asarray(seeds, np.uint32)),
                               net_override)
-    cfg_hash = effective_config_hash(rt, net_override)
+    if time_limit_override:
+        init = rt.set_time_limit(init, time_limit_override)
+    cfg_hash = effective_config_hash(rt, net_override, time_limit_override)
     state, _ = rt.run(init, max_steps, chunk=chunk)
     crashed = np.asarray(state.crashed)
     if crashed.any():
@@ -110,9 +112,13 @@ def simtest(num_seeds: int = 16, max_steps: int = 20_000,
     """Decorator: the wrapped function builds and returns a Runtime (or
     (Runtime, check_fn) where check_fn(final_state) does extra asserts).
 
-    Env knobs (same contract as the reference macro):
+    Env knobs (same contract as the reference macro,
+    madsim-macros/src/lib.rs:120-206):
       MADSIM_TEST_SEED               base seed (default: stable per-test hash)
       MADSIM_TEST_NUM                number of seeds (the batch axis!)
+      MADSIM_TEST_TIME_LIMIT         virtual-time limit in SECONDS (overrides
+                                     cfg.time_limit without recompiling — the
+                                     limit is dynamic state, lib.rs:157-159)
       MADSIM_TEST_CHECK_DETERMINISM  also run seed twice and compare state
     """
 
@@ -126,12 +132,15 @@ def simtest(num_seeds: int = 16, max_steps: int = 20_000,
                 default_seed = int(digest[:8], 16) % (2**31)
             base = _env_int("MADSIM_TEST_SEED", default_seed)
             n = _env_int("MADSIM_TEST_NUM", num_seeds)
+            limit_s = _env_int("MADSIM_TEST_TIME_LIMIT", 0)
             out = fn(*args, **kwargs)
             rt, check_fn = out if isinstance(out, tuple) else (out, None)
             seeds = np.arange(base, base + n, dtype=np.uint32)
             override = env_net_override()
             state = run_seeds(rt, seeds, max_steps, chunk,
-                              net_override=override)
+                              net_override=override,
+                              time_limit_override=(T.sec(limit_s)
+                                                   if limit_s else None))
             if check_fn is not None:
                 check_fn(state)
             if check_determinism or os.environ.get(

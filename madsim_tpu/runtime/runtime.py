@@ -291,6 +291,19 @@ class Runtime:
     def heal(self, state):
         return self.inject(state, T.OP_HEAL)
 
+    def set_time_limit(self, state: SimState, limit: int) -> SimState:
+        """Move the virtual-time limit of every trajectory (the
+        runtime/mod.rs:175-177 set_time_limit analog). The limit is dynamic
+        state, so no recompile: both the hard-stop check and the auto-HALT
+        scenario row (identified by sitting exactly at the current limit)
+        are rewritten in place."""
+        limit = jnp.asarray(limit, jnp.int32)
+        auto = ((state.t_kind == T.EV_SUPER) & (state.t_tag == T.OP_HALT)
+                & (state.t_deadline == jnp.expand_dims(state.tlimit, -1)))
+        return state.replace(
+            tlimit=jnp.full_like(state.tlimit, limit),
+            t_deadline=jnp.where(auto, limit, state.t_deadline))
+
     # ------------------------------------------------------------------
     def fingerprints(self, state: SimState) -> np.ndarray:
         """uint32 fingerprint per trajectory (determinism checks)."""

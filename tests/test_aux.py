@@ -148,6 +148,33 @@ class TestSimtestHarness:
         assert int(np.asarray(state.lat_hi)[0]) == 8000
         assert int(np.asarray(state.msg_dropped).sum()) > 0  # loss applied
 
+    def test_time_limit_env_knob(self, monkeypatch):
+        # MADSIM_TEST_TIME_LIMIT (seconds) shortens the run WITHOUT a
+        # recompile: the limit is dynamic state (macros lib.rs:157-159)
+        from madsim_tpu import simtest
+
+        @simtest(num_seeds=4, max_steps=8000, seed=3)
+        def long_test():
+            return _rt(target=10_000)   # never halts by itself
+
+        monkeypatch.setenv("MADSIM_TEST_TIME_LIMIT", "1")
+        state = long_test()
+        assert bool(np.asarray(state.halted).all())
+        now = np.asarray(state.now)
+        assert (now <= sec(1)).all()            # halted AT the new limit,
+        assert (now >= sec(1) - ms(50)).all()   # not before it
+        assert (np.asarray(state.tlimit) == sec(1)).all()
+
+    def test_set_time_limit_handle(self):
+        # the imperative Handle::set_time_limit analog moves BOTH the
+        # hard-stop and the auto-HALT scenario row
+        rt = _rt(target=10_000)
+        state = rt.set_time_limit(rt.init_batch(np.arange(4)), sec(2))
+        state, _ = rt.run(state, 8000)
+        assert bool(np.asarray(state.halted).all())
+        assert not bool(np.asarray(state.crashed).any())
+        assert (np.asarray(state.now) <= sec(2)).all()
+
     def test_failure_reports_repro_seed(self):
         from madsim_tpu import Program, simtest
         from madsim_tpu.harness.simtest import SimFailure
