@@ -108,7 +108,81 @@ def _batched_eps_with_retry(platform: str) -> float:
     raise last
 
 
+def _sweep_mode():
+    """--sweep: batch x event_capacity tuning sweep on the default
+    platform (short timed segments). Prints one JSON line per config;
+    use it on the chip to pick B_TPU / event_capacity."""
+    import jax
+    from madsim_tpu import Scenario, SimConfig, NetConfig, ms as _ms, sec
+    from madsim_tpu.models.raft import make_raft_runtime
+
+    steps = 256
+    for C in (96, 128):
+        cfg = SimConfig(n_nodes=5, event_capacity=C, time_limit=sec(600),
+                        net=NetConfig(packet_loss_rate=0.05))
+        sc = Scenario()
+        for t in range(8):
+            sc.at(sec(1 + t)).kill_random()
+            sc.at(sec(1 + t) + _ms(400)).restart_random()
+            sc.at(sec(1 + t) + _ms(600)).partition([t % 5, (t + 1) % 5])
+            sc.at(sec(1 + t) + _ms(900)).heal()
+        rt = make_raft_runtime(5, log_capacity=32, n_cmds=24, scenario=sc,
+                               cfg=cfg)
+        runner = rt._run_chunk[False]
+        for B in (2048, 4096, 8192, 16384):
+            state = rt.init_batch(np.arange(B))
+            state, _ = runner(state, steps)      # warm (same chunk length)
+            jax.block_until_ready(state.now)
+            state = rt.init_batch(np.arange(B))
+            t0 = time.perf_counter()
+            state, _ = runner(state, steps)
+            jax.block_until_ready(state.now)
+            eps = B * steps / (time.perf_counter() - t0)
+            print(json.dumps({"metric": "sweep", "batch": B, "capacity": C,
+                              "seed_events_per_sec": round(eps, 1)}))
+
+
+def _scaling_mode():
+    """--scaling: run the sharded path at every mesh size on the virtual
+    8-device CPU mesh and report per-config seed-events/s.
+
+    Virtual devices share one host's cores, so this is NOT a speedup
+    measurement — it is executable evidence that the SPMD program runs at
+    every mesh width (the real-chip expectation is near-linear: lanes are
+    independent, so the step body has no cross-device collectives at all;
+    ICI traffic only appears in explicit reductions like first_crash_seed).
+    """
+    from __graft_entry__ import _force_cpu_mesh
+    jax = _force_cpu_mesh(8)
+    from madsim_tpu.parallel.mesh import seed_mesh, shard_batch
+    rt = _make_runtime()
+    B, steps = 2048, 256
+    rows = []
+    for nd in (1, 2, 4, 8):
+        devices = [d for d in jax.devices() if d.platform == "cpu"][:nd]
+        mesh = seed_mesh(devices)
+        runner = rt._run_chunk[False]
+        state = shard_batch(rt.init_batch(np.arange(B)), mesh)
+        state, _ = runner(state, steps)          # warm/compile
+        jax.block_until_ready(state.now)
+        state = shard_batch(rt.init_batch(np.arange(B)), mesh)
+        t0 = time.perf_counter()
+        state, _ = runner(state, steps)
+        jax.block_until_ready(state.now)
+        eps = B * steps / (time.perf_counter() - t0)
+        rows.append({"devices": nd, "seed_events_per_sec": round(eps, 1)})
+        print(f"  {nd} device(s): {eps:,.0f} seed-events/s", file=sys.stderr)
+    print(json.dumps({"metric": "madraft_fuzz_scaling_cpu_mesh",
+                      "batch": B, "rows": rows}))
+
+
 def main():
+    if "--sweep" in sys.argv:
+        _sweep_mode()
+        return
+    if "--scaling" in sys.argv:
+        _scaling_mode()
+        return
     if "--cpu-baseline" in sys.argv:
         # single-seed sequential loop on CPU: the reference execution model
         print(_events_per_sec(1, CPU_STEPS, WARM))

@@ -63,7 +63,8 @@ class RealRuntime:
 
     def __init__(self, cfg: T.SimConfig, programs: Sequence[Program],
                  state_spec: Any, node_prog=None, base_port: int = 19200,
-                 seed: int = 0, transport: str = "udp"):
+                 seed: int = 0, transport: str = "udp",
+                 persist: Any = None, loss: float = 0.0):
         assert transport in ("udp", "tcp")
         self.transport = transport
         self.cfg = cfg
@@ -72,6 +73,16 @@ class RealRuntime:
                               else [0] * cfg.n_nodes)
         self.spec = state_spec
         self.base_port = base_port
+        # persist: same pytree-of-bools as the simulator Runtime — leaves
+        # marked True survive restart() (the std/fs.rs stable-storage twin:
+        # process memory dies, "disk" doesn't)
+        self.persist = persist
+        # loss: drop this fraction of outgoing datagrams — loopback is
+        # near-lossless, so injected loss is how real-world tests exercise
+        # retry paths with real sockets
+        self.loss = float(loss)
+        import random as _random
+        self._loss_rng = _random.Random(seed)
         self.key = prng.seed_key(seed)
         self.nodes = [RealNode(i, self._fresh_state())
                       for i in range(cfg.n_nodes)]
@@ -176,7 +187,14 @@ class RealRuntime:
 
     async def restart(self, i: int):
         self.kill(i)
-        self.nodes[i].state = self._fresh_state()  # process memory is lost
+        old = self.nodes[i].state
+        fresh = self._fresh_state()                # process memory is lost
+        if self.persist is not None:               # ...stable storage isn't
+            import jax
+            fresh = jax.tree.map(
+                lambda f, o, keep: o if keep else f, fresh, old,
+                self.persist)
+        self.nodes[i].state = fresh
         await self.start_node(i)
 
     def pause(self, i: int):
@@ -225,6 +243,8 @@ class RealRuntime:
             dst = int(e["dst"])
             if not (0 <= dst < self.cfg.n_nodes) or not n.alive:
                 continue
+            if self.loss and self._loss_rng.random() < self.loss:
+                continue  # injected packet loss (real networks drop; loopback won't)
             pkt = struct.pack(f"<ii{P}i", int(e["tag"]), n.id,
                               *np.asarray(e["payload"], np.int32))
             # real send: straight to the peer; latency, loss, and

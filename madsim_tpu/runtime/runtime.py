@@ -96,11 +96,18 @@ class Runtime:
         src = np.zeros(C, np.int32)
         tag = np.zeros(C, np.int32)
         payload = np.zeros((C, Pw), np.int32)
-        # node boots
+        # node boots at t=0 — except nodes with a scheduled Scenario.boot
+        # (the create_node analog), which come up at their scheduled time
+        deferred = {r.node for r in self.scenario.rows
+                    if r.op == T.OP_INIT and r.node != T.NODE_RANDOM}
         deadline[:n_init] = 0
         kind[:n_init] = T.EV_SUPER
         node[:n_init] = np.arange(n_init)
         tag[:n_init] = T.OP_INIT
+        for d in deferred:
+            deadline[d] = T.T_INF
+            kind[d] = 0
+            tag[d] = 0
         # scenario ops
         r = rows["time"].shape[0]
         deadline[n_init:n_rows] = rows["time"]
@@ -182,7 +189,21 @@ class Runtime:
         early; without compaction they occupy device lanes doing nothing.
 
         Returns the full-batch final state in the ORIGINAL lane order.
+
+        Single-process only: compaction re-packs lanes through host numpy,
+        which requires every shard to be addressable from this process.
+        Under multi-process sharding (parallel/distributed.py) run() works
+        unchanged — frozen lanes are already ~free there — or compact each
+        host's local slice before assembling the global batch.
         """
+        leaf = jax.tree.leaves(state)[0]
+        if (hasattr(leaf, "is_fully_addressable")
+                and not leaf.is_fully_addressable):
+            raise ValueError(
+                "run_compacting gathers lanes host-side and needs a fully "
+                "addressable (single-process) batch; under multi-process "
+                "sharding use run(), or compact per-host slices before "
+                "assembly")
         runner = self._run_chunk[False]
         B = int(np.asarray(state.halted).shape[0])
         orig_idx = np.arange(B)

@@ -87,6 +87,13 @@ class _At:
         return self
 
     # -- node lifecycle (Handle::kill/restart/pause/resume) ----------------
+    def boot(self, node):
+        """Bring `node` up at this time instead of t=0 — the
+        Handle::create_node analog (runtime/mod.rs:66-76): scheduling a
+        boot makes the Runtime skip that node's automatic t=0 init, so the
+        node simply does not exist (messages to it vanish) until now."""
+        return self._add(T.OP_INIT, node)
+
     def kill(self, node):
         return self._add(T.OP_KILL, node)
 

@@ -9,6 +9,9 @@ for i in $(seq 1 200); do
     echo "$(date -u +%H:%M:%S) tunnel alive, running bench" >> tpu_watch.log
     python bench.py > BENCH_tpu.json 2>> tpu_watch.log
     echo "$(date -u +%H:%M:%S) bench done rc=$?" >> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) running tuning sweep" >> tpu_watch.log
+    python bench.py --sweep > BENCH_tpu_sweep.json 2>> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) sweep done rc=$?" >> tpu_watch.log
     exit 0
   fi
   echo "$(date -u +%H:%M:%S) probe $i: tunnel dead" >> tpu_watch.log

@@ -201,6 +201,25 @@ class TestSimtestHarness:
             assert e.code == 99
 
 
+class TestLateBoot:
+    def test_scenario_boot_defers_node_creation(self):
+        # Handle::create_node analog: a node with a scheduled boot does not
+        # exist until then — the pinger can make no progress before sec(1)
+        from madsim_tpu import Scenario
+        from madsim_tpu.harness.simtest import run_seeds
+        from madsim_tpu.models.pingpong import PingPong, state_spec
+        sc = Scenario()
+        sc.at(sec(1)).boot(1)
+        cfg = SimConfig(n_nodes=2, time_limit=sec(10))
+        rt = Runtime(cfg, [PingPong(2, target=5)], state_spec(),
+                     scenario=sc)
+        state = run_seeds(rt, np.arange(8), max_steps=20_000)
+        acked = np.asarray(state.node_state["acked"])[:, 0]
+        now = np.asarray(state.now)
+        assert (acked >= 5).all()
+        assert (now > sec(1)).all()      # nothing could complete earlier
+
+
 class TestChromeTrace:
     def test_export_chrome_trace(self, tmp_path):
         import json

@@ -14,6 +14,7 @@ from madsim_tpu.models.rpc_echo import (EchoClient, EchoServer,
 from madsim_tpu.real.runtime import RealRuntime
 
 
+@pytest.mark.realworld
 class TestRealWorld:
     def test_pingpong_over_real_udp(self):
         n = 3
@@ -70,6 +71,7 @@ class TestRealWorld:
         assert int(rt.states()[0]["acked"]) >= 8
 
 
+@pytest.mark.realworld
 class TestRealTcp:
     def test_pingpong_over_real_tcp(self):
         # same program, third transport: length-delimited frames over real
@@ -111,3 +113,54 @@ class TestRealTcp:
         assert not rt.crashed
         acked = [int(s["acked"]) for s in rt.states()[1:]]
         assert all(a >= 6 for a in acked), acked
+
+
+@pytest.mark.realworld
+class TestRealDurability:
+    def test_wal_kv_persists_across_real_restart(self):
+        # the std/fs.rs twin: RealRuntime(persist=...) keeps stable-storage
+        # leaves across restart, so the WAL-KV durability oracle (an acked
+        # write must never be un-written) holds over real sockets too
+        import asyncio
+
+        from madsim_tpu.models.wal_kv import (WalKvClient, WalKvServer,
+                                              wal_persist_spec,
+                                              wal_state_spec)
+
+        cfg = SimConfig(n_nodes=2, time_limit=sec(30))
+        rt = RealRuntime(cfg, [WalKvServer(n_keys=2, wal_cap=8),
+                               WalKvClient(n_ops=10, keys_per_client=2,
+                                           timeout=ms(80), think=ms(10))],
+                         wal_state_spec(2, 2, 8, 2), node_prog=[0, 1],
+                         base_port=19420, persist=wal_persist_spec())
+
+        async def scenario():
+            rt._loop = asyncio.get_running_loop()
+            rt.t0 = __import__("time").monotonic()
+            for i in range(2):
+                await rt.start_node(i)
+            await asyncio.sleep(0.25)
+            rt.kill(0)                    # power-fail the server for real
+            await asyncio.sleep(0.25)
+            await rt.restart(0)           # disk view survives, memory dies
+            try:
+                await asyncio.wait_for(rt._halted.wait(), timeout=8.0)
+            except asyncio.TimeoutError:
+                pass
+            for i in range(2):
+                rt.kill(i)
+
+        asyncio.run(scenario())
+        assert not rt.crashed             # the durability oracle is armed
+        assert int(rt.states()[1]["c_opn"]) >= 10
+
+    def test_pingpong_completes_under_injected_loss(self):
+        # loopback never drops, so inject loss in the runtime itself: the
+        # retry timers must still carry the workload to completion
+        n = 3
+        cfg = SimConfig(n_nodes=n, time_limit=sec(20))
+        rt = RealRuntime(cfg, [PingPong(n, target=6, retry=ms(25))],
+                         state_spec(), base_port=19440, loss=0.3)
+        rt.run(duration=8.0)
+        assert not rt.crashed
+        assert int(rt.states()[0]["acked"]) >= 6
