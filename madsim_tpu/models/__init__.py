@@ -5,8 +5,18 @@ Each model is a set of `Program` state machines plus invariants and a
 
   pingpong          — request/response with retries (endpoint examples)
   rpc_echo          — client/server RPC service under faults (tonic-example)
-  raft              — leader election + log replication (MadRaft core)
-  raft_kv           — replicated KV with client histories + linearizability
+  stream_echo       — streaming RPC shapes: client/server/bidi with
+                      backpressure + kill-mid-stream recovery (tonic streams)
+  raft              — leader election + log replication + log compaction /
+                      InstallSnapshot (MadRaft core)
+  raft_kv           — replicated KV with materialized state machine, chunked
+                      snapshots, client histories + linearizability
+  chain             — chain replication: reconfiguring master, lease-gated
+                      tail reads, per-event two-tails invariant
+  minipg            — postgres-shaped session protocol (auth handshake,
+                      pipelining, transactions) over sim AND real sockets
+  wal_kv            — WAL + checkpoint durability on the simulated
+                      filesystem; red/green power-fail proof
   two_phase_commit  — atomic commit with write-ahead state
   gossip            — epidemic broadcast with anti-entropy push-back
   bank              — Jepsen-style transfers with money conservation

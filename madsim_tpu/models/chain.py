@@ -1,0 +1,415 @@
+"""Chain replication with a reconfiguring master (van Renesse & Schneider,
+OSDI'04) — a second replication family beside Raft, exercising a different
+fault-tolerance style: fail-stop membership ruled by a master, not quorum
+voting.
+
+Cluster: node 0 = master, nodes 1..R = replicas, R+1.. = clients.
+
+  * WRITES enter at the HEAD and propagate down the chain; the TAIL acks
+    the client. Propagation is idempotent (monotonic per-client ids dedup
+    at every hop), so client retry-through-head is the repair mechanism
+    for writes stranded by a mid-chain failure.
+  * READS are served by the tail alone, gated by a LEASE. Virtual time is
+    one synchronized clock across the cluster, so leases are EXACT — the
+    sim can state, and check after every event, the invariant that at most
+    one replica ever believes it is a lease-holding tail
+    (CRASH_TWO_TAILS). The master activates a new epoch only after
+    old leases provably expired (wait > lease + max latency).
+  * Membership: replicas ping the master; a silent replica is declared
+    dead and the chain shrinks (survivors keep their order — which is
+    what makes acked writes safe across reconfiguration: an ack means
+    every live chain member applied, and the new chain is a subset).
+    A restarted replica re-enters ONLY if the master had not yet removed
+    it (short blip: persisted kv + client retries make that safe);
+    once removed it stays out — rejoin-with-state-transfer is Raft's
+    jurisdiction (models/raft_kv.py).
+
+Histories are recorded client-side and checked with the linearizability
+checker (the same oracle as KV-on-Raft).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.api import Ctx, Program
+from ..core.types import ms
+
+# message tags
+CFG_REQ, CFG, BEAT, PING, WRITE, READ, CRSP = 11, 12, 13, 14, 15, 16, 17
+# timer tags
+T_BEAT, T_PING, T_CHECK, T_ACT, T_NEW, T_RETRY = 1, 2, 3, 4, 5, 6
+# CRSP statuses
+ST_OK, ST_REFUSE = 1, 2
+
+OP_PUT, OP_GET = 1, 2
+
+CRASH_TWO_TAILS = 501
+
+MASTER = 0
+
+
+def chain_state_spec(n_nodes: int, n_replicas: int, n_keys: int,
+                     n_ops: int):
+    z = jnp.asarray(0, jnp.int32)
+    R = n_replicas
+    return dict(
+        # master
+        m_last=jnp.zeros((n_nodes,), jnp.int32),   # last ping per node
+        m_epoch=jnp.asarray(1, jnp.int32),
+        m_chain=jnp.zeros((R,), jnp.int32),
+        m_len=z,
+        m_pend=z,
+        # replica
+        r_epoch=z,
+        r_chain=jnp.zeros((R,), jnp.int32),
+        r_len=z,
+        r_pos=jnp.asarray(-1, jnp.int32),
+        r_lease=z,
+        kv=jnp.zeros((n_keys,), jnp.int32),
+        sess_rtag=jnp.zeros((n_nodes,), jnp.int32),
+        # client
+        c_epoch=z, c_head=z, c_tail=z, c_have=z,
+        c_opn=z, c_wait=z, c_op=z, c_key=z, c_val=z,
+        h_op=jnp.zeros((n_ops,), jnp.int32),
+        h_key=jnp.zeros((n_ops,), jnp.int32),
+        h_val=jnp.zeros((n_ops,), jnp.int32),
+        h_inv=jnp.full((n_ops,), -1, jnp.int32),
+        h_resp=jnp.full((n_ops,), -1, jnp.int32),
+    )
+
+
+def chain_persist_spec(spec):
+    """The replicated register state survives a blip-restart; config and
+    lease deliberately do NOT (a restarted node must re-learn the epoch
+    before it can act, and can never resurrect an expired lease)."""
+    return {k: k in ("kv", "sess_rtag") for k in spec}
+
+
+class ChainMaster(Program):
+    """Failure detector + configuration service.
+
+    Reconfiguration protocol: on detecting a dead chain member, wait
+    `wait` (> lease: expiries are grant-anchored at send time, so every
+    lease granted under the old epoch has expired after `wait` regardless
+    of delivery delays), then activate epoch+1 with the dead members
+    removed and resume config beats. `wait` <= lease is a real protocol
+    bug — tests inject it and the two-tails invariant catches the
+    consequence.
+    """
+
+    def __init__(self, n_replicas: int, lease=ms(120), beat_every=ms(30),
+                 check_every=ms(40), dead_after=ms(100), wait=None):
+        self.R = n_replicas
+        self.lease = lease
+        self.hb = beat_every
+        self.chk = check_every
+        self.dead = dead_after
+        self.wait = wait if wait is not None else lease + ms(30)
+
+    def init(self, ctx: Ctx):
+        st = dict(ctx.state)
+        only = ctx.node == MASTER
+        # initial chain: all replicas, in id order
+        st["m_chain"] = jnp.where(only,
+                                  jnp.arange(1, self.R + 1, dtype=jnp.int32),
+                                  st["m_chain"])
+        st["m_len"] = jnp.where(only, self.R, st["m_len"])
+        st["m_last"] = jnp.where(only, jnp.full_like(st["m_last"], ctx.now),
+                                 st["m_last"])
+        ctx.set_timer(self.hb, T_BEAT, [0], when=only)
+        ctx.set_timer(self.chk, T_CHECK, [0], when=only)
+        ctx.state = st
+
+    def _members(self, st):
+        ks = jnp.arange(self.R, dtype=jnp.int32)
+        return st["m_chain"], ks < st["m_len"]
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        chain, member = self._members(st)
+
+        # config beats to current members (removed nodes must never get a
+        # fresh lease). The lease expiry is GRANT-anchored (computed at
+        # send time and carried in the beat): a delayed or parked beat can
+        # then never resurrect an expired lease at delivery time, so the
+        # master's wait bound is simply wait > lease, independent of
+        # network latency or pause/resume timing.
+        is_beat = tag == T_BEAT
+        expiry = ctx.now + self.lease
+        beat_payload = jnp.concatenate(
+            [jnp.stack([st["m_epoch"], st["m_len"], expiry]), chain])
+        for i in range(self.R):
+            ctx.send(chain[i], BEAT, beat_payload,
+                     when=is_beat & member[i] & (st["m_pend"] == 0))
+        ctx.set_timer(self.hb, T_BEAT, [0], when=is_beat)
+
+        # failure detection: a silent chain member triggers reconfiguration
+        is_chk = tag == T_CHECK
+        silent = (ctx.now - st["m_last"][jnp.clip(chain, 0, None)]
+                  > self.dead)
+        any_dead = (silent & member).any()
+        start = is_chk & any_dead & (st["m_pend"] == 0)
+        st["m_pend"] = jnp.where(start, 1, st["m_pend"])
+        ctx.set_timer(self.wait, T_ACT, [0], when=start)
+        ctx.set_timer(self.chk, T_CHECK, [0], when=is_chk)
+
+        # activation: drop every member that is STILL silent now, bump the
+        # epoch, resume beats. Survivors keep their relative order.
+        is_act = (tag == T_ACT) & (st["m_pend"] == 1)
+        alive_now = ~(ctx.now - st["m_last"][jnp.clip(chain, 0, None)]
+                      > self.dead)
+        keep = member & alive_now
+        # compact survivors, preserving order — gather formulation (the
+        # j-th new slot takes the (j+1)-th kept element; a duplicate-index
+        # scatter would have undefined, nondeterministic ordering)
+        cs = jnp.cumsum(keep.astype(jnp.int32))
+        ks_r = jnp.arange(self.R, dtype=jnp.int32)
+        srcs = jnp.searchsorted(cs, ks_r + 1)
+        new_chain = jnp.where(ks_r < keep.sum(),
+                              chain[jnp.clip(srcs, 0, self.R - 1)], 0)
+        changed = keep.sum() < st["m_len"]
+        st["m_chain"] = jnp.where(is_act & changed, new_chain,
+                                  st["m_chain"])
+        st["m_len"] = jnp.where(is_act & changed,
+                                keep.sum(dtype=jnp.int32), st["m_len"])
+        st["m_epoch"] = st["m_epoch"] + (is_act & changed)
+        st["m_pend"] = jnp.where(is_act, 0, st["m_pend"])
+        ctx.state = st
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        is_ping = tag == PING
+        sc = jnp.clip(src, 0, st["m_last"].shape[0] - 1)
+        st["m_last"] = st["m_last"].at[sc].set(
+            jnp.where(is_ping, ctx.now, st["m_last"][sc]))
+        # config queries (clients): head/tail of the CURRENT epoch
+        is_req = tag == CFG_REQ
+        head = st["m_chain"][0]
+        tail = st["m_chain"][jnp.clip(st["m_len"] - 1, 0, self.R - 1)]
+        ctx.send(src, CFG, [st["m_epoch"], head, tail,
+                            payload[0]], when=is_req & (st["m_len"] > 0))
+        ctx.state = st
+
+
+class ChainReplica(Program):
+    def __init__(self, n_replicas: int, n_keys: int, ping_every=ms(25)):
+        self.R = n_replicas
+        self.K = n_keys
+        self.hp = ping_every
+
+    def init(self, ctx: Ctx):
+        ctx.set_timer(ctx.randint(0, self.hp), T_PING, [0])
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        is_ping = tag == T_PING
+        ctx.send(MASTER, PING, [0], when=is_ping)
+        ctx.set_timer(self.hp, T_PING, [0], when=is_ping)
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        R = self.R
+
+        # ---- config beat: adopt newer epochs, extend the lease ----------
+        is_beat = (tag == BEAT) & (src == MASTER)
+        epoch, clen, expiry = payload[0], payload[1], payload[2]
+        chain = payload[3:3 + R]
+        newer = is_beat & (epoch >= st["r_epoch"])
+        st["r_epoch"] = jnp.where(newer, epoch, st["r_epoch"])
+        st["r_chain"] = jnp.where(newer, chain, st["r_chain"])
+        st["r_len"] = jnp.where(newer, clen, st["r_len"])
+        ks = jnp.arange(R, dtype=jnp.int32)
+        mypos = jnp.max(jnp.where((chain == ctx.node) & (ks < clen),
+                                  ks, -1))
+        st["r_pos"] = jnp.where(newer, mypos, st["r_pos"])
+        # grant-anchored: take the master's expiry, never ctx.now + lease —
+        # a parked/delayed beat must not revive a lease at delivery time
+        st["r_lease"] = jnp.where(newer,
+                                  jnp.maximum(st["r_lease"], expiry),
+                                  st["r_lease"])
+
+        # ---- write propagation (idempotent at every hop) ----------------
+        is_w = (tag == WRITE) & (payload[0] == st["r_epoch"]) & (
+            st["r_pos"] >= 0)
+        client, rtag = payload[1], payload[2]
+        key = jnp.clip(payload[3], 0, self.K - 1)
+        val = payload[4]
+        cc = jnp.clip(client, 0, st["sess_rtag"].shape[0] - 1)
+        fresh = is_w & (rtag > st["sess_rtag"][cc])
+        st["kv"] = st["kv"].at[key].set(jnp.where(fresh, val,
+                                                  st["kv"][key]))
+        st["sess_rtag"] = st["sess_rtag"].at[cc].set(
+            jnp.where(fresh, rtag, st["sess_rtag"][cc]))
+        at_tail = st["r_pos"] == st["r_len"] - 1
+        succ = st["r_chain"][jnp.clip(st["r_pos"] + 1, 0, R - 1)]
+        # forward down-chain or ack the client (shared send slot)
+        ctx.send(jnp.where(at_tail, client, succ),
+                 jnp.where(at_tail, CRSP, WRITE),
+                 jnp.where(at_tail,
+                           jnp.stack([rtag, jnp.asarray(ST_OK, jnp.int32),
+                                      val, 0, 0]),
+                           payload[:5]),
+                 when=is_w)
+
+        # ---- reads: tail-only, lease-gated ------------------------------
+        is_r = (tag == READ) & (payload[0] == st["r_epoch"])
+        serving = (st["r_pos"] >= 0) & at_tail & (ctx.now < st["r_lease"])
+        rr_client, rr_tag = payload[1], payload[2]
+        rkey = jnp.clip(payload[3], 0, self.K - 1)
+        ctx.send(rr_client, CRSP,
+                 [rr_tag,
+                  jnp.where(serving, ST_OK, ST_REFUSE),
+                  st["kv"][rkey]],
+                 when=is_r)
+        # stale-epoch reads are refused too (shares the same slot via mask)
+        ctx.send(payload[1], CRSP, [payload[2], ST_REFUSE, 0],
+                 when=(tag == READ) & (payload[0] != st["r_epoch"]))
+        ctx.state = st
+
+
+class ChainClient(Program):
+    """Sequential PUT/GET over its own key range; refetches the config and
+    retries (same monotonic rtag) on timeout or refusal."""
+
+    def __init__(self, n_replicas: int, n_ops: int,
+                 keys_per_client: int = 2, timeout=ms(60), think=ms(8)):
+        self.R = n_replicas
+        self.O = n_ops
+        self.KPC = keys_per_client
+        self.timeout = timeout
+        self.think = think
+
+    def _key(self, ctx, st):
+        base = (ctx.node - 1 - self.R) * self.KPC
+        return base + (st["c_opn"] // 2) % self.KPC
+
+    def init(self, ctx: Ctx):
+        ctx.set_timer(ctx.randint(0, ms(15)), T_NEW, [0])
+
+    def _issue(self, ctx, st, when):
+        rtag = st["c_opn"] + 1
+        is_put = st["c_op"] == OP_PUT
+        dst = jnp.where(is_put, st["c_head"], st["c_tail"])
+        body = jnp.stack([st["c_epoch"], ctx.node, rtag,
+                          self._key(ctx, st), st["c_val"]])
+        ctx.send(dst, jnp.where(is_put, WRITE, READ), body,
+                 when=when & (st["c_have"] == 1))
+        ctx.send(MASTER, CFG_REQ, [rtag], when=when & (st["c_have"] == 0))
+        ctx.set_timer(self.timeout, T_RETRY, [rtag], when=when)
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        start = ((tag == T_NEW) & (st["c_wait"] == 0)
+                 & (st["c_opn"] < self.O))
+        st["c_op"] = jnp.where(start,
+                               jnp.where(st["c_opn"] % 2 == 0, OP_PUT,
+                                         OP_GET), st["c_op"])
+        st["c_val"] = jnp.where(start & (st["c_op"] == OP_PUT),
+                                ctx.node * 4096 + st["c_opn"] + 1,
+                                st["c_val"])
+        st["c_wait"] = jnp.where(start, 1, st["c_wait"])
+        oidx = jnp.clip(st["c_opn"], 0, self.O - 1)
+        for col, v in (("h_op", st["c_op"]), ("h_key", self._key(ctx, st)),
+                       ("h_val", st["c_val"]), ("h_inv", ctx.now)):
+            st[col] = st[col].at[oidx].set(
+                jnp.where(start, v, st[col][oidx]))
+
+        # timeout: config may be stale (dead head/tail, new epoch) —
+        # refetch, then retry the SAME rtag
+        retry = ((tag == T_RETRY) & (st["c_wait"] == 1)
+                 & (payload[0] == st["c_opn"] + 1))
+        st["c_have"] = jnp.where(retry, 0, st["c_have"])
+        self._issue(ctx, st, start | retry)
+        ctx.state = st
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        # config reply -> re-issue the in-flight op immediately
+        is_cfg = (tag == CFG) & (src == MASTER)
+        st["c_epoch"] = jnp.where(is_cfg, payload[0], st["c_epoch"])
+        st["c_head"] = jnp.where(is_cfg, payload[1], st["c_head"])
+        st["c_tail"] = jnp.where(is_cfg, payload[2], st["c_tail"])
+        st["c_have"] = jnp.where(is_cfg, 1, st["c_have"])
+        reissue = is_cfg & (st["c_wait"] == 1)
+        self._issue(ctx, st, reissue)
+
+        # operation response
+        hit = ((tag == CRSP) & (st["c_wait"] == 1)
+               & (payload[0] == st["c_opn"] + 1))
+        ok = hit & (payload[1] == ST_OK)
+        # a refusal (stale tail / expired lease) = refetch config + retry
+        refused = hit & (payload[1] == ST_REFUSE)
+        st["c_have"] = jnp.where(refused, 0, st["c_have"])
+        ctx.send(MASTER, CFG_REQ, [st["c_opn"] + 1], when=refused)
+
+        oidx = jnp.clip(st["c_opn"], 0, self.O - 1)
+        st["h_resp"] = st["h_resp"].at[oidx].set(
+            jnp.where(ok, ctx.now, st["h_resp"][oidx]))
+        st["h_val"] = st["h_val"].at[oidx].set(
+            jnp.where(ok & (st["h_op"][oidx] == OP_GET), payload[2],
+                      st["h_val"][oidx]))
+        st["c_opn"] = st["c_opn"] + ok
+        st["c_wait"] = jnp.where(ok, 0, st["c_wait"])
+        ctx.set_timer(self.think, T_NEW, [0], when=ok)
+        ctx.state = st
+
+
+def chain_invariant(n_nodes: int, n_replicas: int):
+    """At most one replica may simultaneously believe it is a
+    lease-holding tail — the property the master's wait-before-activate
+    protocol guarantees, checkable exactly because virtual time is one
+    synchronized clock."""
+    replica = np.zeros(n_nodes, bool)
+    replica[1:1 + n_replicas] = True
+    rmask = jnp.asarray(replica)
+
+    def invariant(state):
+        ns = state.node_state
+        serving = (rmask & state.alive & (ns["r_pos"] >= 0)
+                   & (ns["r_pos"] == ns["r_len"] - 1)
+                   & (state.now < ns["r_lease"]))
+        bad = serving.sum() > 1
+        return bad, jnp.asarray(CRASH_TWO_TAILS, jnp.int32)
+
+    return invariant
+
+
+def all_done(n_replicas: int, n_ops: int):
+    def check(state):
+        return (state.node_state["c_opn"][1 + n_replicas:] >= n_ops).all()
+    return check
+
+
+def make_chain_runtime(n_replicas=3, n_clients=2, n_ops=10,
+                       keys_per_client=2, scenario=None, cfg=None,
+                       lease=ms(120), master_wait=None):
+    from ..core.types import NetConfig, SimConfig, sec
+    from ..runtime.runtime import Runtime
+    n = 1 + n_replicas + n_clients
+    n_keys = n_clients * keys_per_client
+    if cfg is None:
+        cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=12,
+                        time_limit=sec(10),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(8)))
+    assert cfg.payload_words >= 3 + n_replicas  # BEAT: epoch,len,expiry,chain
+    spec = chain_state_spec(n, n_replicas, n_keys, n_ops)
+    master = ChainMaster(n_replicas, lease=lease, wait=master_wait)
+    replica = ChainReplica(n_replicas, n_keys)
+    client = ChainClient(n_replicas, n_ops, keys_per_client)
+    node_prog = np.asarray([0] + [1] * n_replicas + [2] * n_clients,
+                           np.int32)
+    return Runtime(cfg, [master, replica, client], spec,
+                   node_prog=node_prog, scenario=scenario,
+                   invariant=chain_invariant(n, n_replicas),
+                   persist=chain_persist_spec(spec),
+                   halt_when=all_done(n_replicas, n_ops))
+
+
+def extract_histories(state, n_replicas: int, n_clients: int):
+    """Client histories for the linearizability checker — same state
+    layout as KV-on-Raft, so the extraction is shared; only the client
+    slice start differs (clients sit after master + replicas)."""
+    from .raft_kv import extract_histories as _extract
+    return _extract(state, 1 + n_replicas, n_clients)
