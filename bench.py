@@ -96,11 +96,20 @@ def _tpu_alive(timeout: float = 90.0) -> bool:
 
 
 def _batched_eps_with_retry(platform: str) -> float:
-    """Timed batched run; one retry for transient tunnel flakes."""
+    """Timed batched run; one retry for transient tunnel flakes. The CPU
+    fallback sweeps a few batch sizes (B_TPU is tuned for the chip's
+    lanes, not for a host CPU) and reports the best."""
+    sizes = (B_TPU,) if platform == "tpu" else (512, 2048, B_TPU)
     last = None
     for attempt in (1, 2):
         try:
-            return _events_per_sec(B_TPU, STEPS, WARM)
+            best = 0.0
+            for b in sizes:
+                eps = _events_per_sec(b, STEPS, WARM)
+                print(f"{platform} batched {b} seeds: {eps:,.0f} "
+                      f"seed-events/s", file=sys.stderr)
+                best = max(best, eps)
+            return best
         except Exception as e:  # noqa: BLE001 - retry then surface
             last = e
             print(f"{platform} batched run attempt {attempt} failed: {e!r}",
@@ -214,8 +223,6 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     batched_eps = _batched_eps_with_retry("tpu" if on_tpu else "cpu")
-    print(f"{'tpu' if on_tpu else 'cpu'} batched ({B_TPU} seeds): "
-          f"{batched_eps:,.0f} seed-events/s", file=sys.stderr)
 
     result = {
         "metric": "madraft_fuzz_seed_events_per_sec",
