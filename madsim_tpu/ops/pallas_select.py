@@ -1,0 +1,140 @@
+"""Fused Pallas scheduler kernel — the select+pop+free-scan of the event
+engine in ONE VMEM pass per batch block.
+
+The engine's per-step scheduling reads the `[batch, C]` event table three
+times through separate XLA reductions (ops/select.py): earliest eligible
+deadline, random tie-break, first-K free slots. On TPU those are small
+VPU kernels whose cost is dominated by HBM round-trips of the same table
+slices; this kernel fuses them so each `[8, C]` block is loaded into VMEM
+once. It is the kernel DESIGN.md §5 contemplates and VERDICT r1 names as
+the lever IF XLA's fusion of the unfused path proves poor — so it ships
+OPT-IN (engine integration pending a real-chip profile), with interpret-
+mode differential tests (tests/test_pallas_select.py) proving semantics
+against ops/select on any platform.
+
+Design notes (TPU constraints, /opt/skills/guides/pallas_guide.md):
+  * no lane-axis cumsum: the uniform tie-break uses keyed HASH PRIORITIES
+    (argmax of iid hashes over the tie set is a uniform draw) and
+    first-K-free uses K iterative min-index extractions — min/max
+    reductions only, all VPU-friendly;
+  * the tie-break therefore draws DIFFERENTLY from ops/select.masked_choice
+    for the same key (both uniform; schedules are reproducible per path,
+    not across paths);
+  * outputs pack into one [batch, 128] int32 tile (col 0 dmin, 1 idx,
+    2 any-eligible, 8.. slots, 64.. ok flags) to keep every ref lane-tiled.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_HASH_A = -1640531527   # 2654435761 as int32 (Knuth multiplicative)
+_HASH_B = -1028477387   # 0xC2B2AE3D as int32 (murmur3 finalizer constant)
+
+_COL_DMIN, _COL_IDX, _COL_ANY = 0, 1, 2
+_COL_SLOTS, _COL_OK = 8, 64
+MAX_FREE = _COL_OK - _COL_SLOTS  # 56 emission slots — far above any model
+
+
+def _kernel(dl_ref, el_ref, fr_ref, rnd_ref, out_ref, *, n_free, inf):
+    dl = dl_ref[:]
+    el = el_ref[:] != 0
+    fr = fr_ref[:] != 0
+    rnd = rnd_ref[:, :1]                       # [BB, 1] per-lane random bits
+    bb, cc = dl.shape
+    lane = jax.lax.broadcasted_iota(jnp.int32, (bb, cc), 1)
+    ocol = jax.lax.broadcasted_iota(jnp.int32, (bb, 128), 1)
+    big = jnp.asarray(inf, jnp.int32)
+
+    # earliest eligible deadline + tie set
+    masked = jnp.where(el, dl, big)
+    dmin = jnp.min(masked, axis=1, keepdims=True)          # [BB, 1]
+    any_el = (dmin < big).astype(jnp.int32)
+    at_min = el & (dl == dmin)
+
+    # uniform tie-break: max keyed hash priority, lowest lane breaks the
+    # (measure-zero) hash collision deterministically
+    h = (rnd ^ (lane * jnp.asarray(_HASH_A, jnp.int32))) \
+        * jnp.asarray(_HASH_B, jnp.int32)
+    pri = jnp.where(at_min, h, jnp.asarray(-2**31, jnp.int32))
+    pmax = jnp.max(pri, axis=1, keepdims=True)
+    cand = jnp.where(at_min & (pri == pmax), lane, big)
+    idx = jnp.min(cand, axis=1, keepdims=True)             # [BB, 1]
+    idx = jnp.where(any_el == 1, idx, 0)
+
+    out = jnp.zeros((bb, 128), jnp.int32)
+    out = jnp.where(ocol == _COL_DMIN, dmin, out)
+    out = jnp.where(ocol == _COL_IDX, idx, out)
+    out = jnp.where(ocol == _COL_ANY, any_el, out)
+
+    # first n_free free slots, in index order: iterative min-extraction
+    frm = fr
+    for j in range(n_free):
+        candf = jnp.where(frm, lane, big)
+        sj = jnp.min(candf, axis=1, keepdims=True)         # [BB, 1]
+        okj = (sj < big).astype(jnp.int32)
+        frm = frm & (lane != sj)
+        out = jnp.where(ocol == _COL_SLOTS + j, jnp.where(okj == 1, sj, 0),
+                        out)
+        out = jnp.where(ocol == _COL_OK + j, okj, out)
+
+    out_ref[:] = out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_free", "inf", "interpret"))
+def fused_schedule(deadlines, eligible, free, rand_bits, *, n_free: int,
+                   inf: int, interpret: bool | None = None):
+    """Batched fused scheduling pass.
+
+    Args:
+      deadlines: int32[B, C]; eligible/free: bool[B, C];
+      rand_bits: int32[B] (one draw per lane, e.g. prng bits).
+      n_free: how many free slots to extract (the engine's E).
+      inf:    the T_INF sentinel.
+      interpret: force pallas interpreter (default: auto — True off-TPU).
+
+    Returns (dmin[B], idx[B], any_eligible[B], slots[B, n_free],
+    ok[B, n_free]) with ops/select semantics (tie-break draw differs; see
+    module docstring).
+    """
+    from jax.experimental import pallas as pl
+
+    assert n_free <= MAX_FREE, f"n_free > {MAX_FREE} packed-output slots"
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    B, C = deadlines.shape
+    BB = -(-B // 8) * 8
+    CC = -(-C // 128) * 128
+    pad = ((0, BB - B), (0, CC - C))
+    dl = jnp.pad(jnp.asarray(deadlines, jnp.int32), pad,
+                 constant_values=inf)
+    el = jnp.pad(eligible.astype(jnp.int32), pad)
+    fr = jnp.pad(free.astype(jnp.int32), pad)
+    rnd = jnp.pad(jnp.broadcast_to(
+        jnp.asarray(rand_bits, jnp.int32)[:, None], (B, 128)),
+        ((0, BB - B), (0, 0)))
+
+    kern = functools.partial(_kernel, n_free=n_free, inf=inf)
+    out = pl.pallas_call(
+        kern,
+        grid=(BB // 8,),
+        in_specs=[pl.BlockSpec((8, CC), lambda i: (i, 0)),
+                  pl.BlockSpec((8, CC), lambda i: (i, 0)),
+                  pl.BlockSpec((8, CC), lambda i: (i, 0)),
+                  pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BB, 128), jnp.int32),
+        interpret=interpret,
+    )(dl, el, fr, rnd)
+
+    out = out[:B]
+    dmin = out[:, _COL_DMIN]
+    idx = out[:, _COL_IDX]
+    any_el = out[:, _COL_ANY] == 1
+    slots = out[:, _COL_SLOTS:_COL_SLOTS + n_free]
+    ok = out[:, _COL_OK:_COL_OK + n_free] == 1
+    return dmin, idx, any_el, slots, ok
