@@ -25,14 +25,19 @@ def _columns(events: dict, b: int):
     return cols, np.nonzero(cols["fired"])[0]
 
 
-def format_trace(events: dict, b: int = 0, time_start: int = 0,
+def format_trace(events: dict, b: int = 0, time_start: int | None = None,
                  node_names=None, limit: int | None = None) -> list[str]:
     """Render trajectory b's event stream as text lines.
 
     events: the structure returned by Runtime.run(collect_events=True) —
     arrays shaped [steps, batch, ...]. time_start filters records before a
-    virtual instant (the MADSIM_LOG_TIME_START analog).
+    virtual instant; when None it honors the MADSIM_LOG_TIME_START env var
+    (milliseconds — the runtime/mod.rs:349-358 contract).
     """
+    if time_start is None:
+        import os
+        v = os.environ.get("MADSIM_LOG_TIME_START")
+        time_start = int(float(v) * T.TICKS_PER_MS) if v else 0
     cols, idx = _columns(events, b)
     now, kind = cols["now"], cols["kind"]
     node, src, tag = cols["node"], cols["src"], cols["tag"]

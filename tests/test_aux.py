@@ -237,3 +237,20 @@ class TestChromeTrace:
         # timestamps are virtual microseconds, monotone nondecreasing
         ts = [e["ts"] for e in evs]
         assert ts == sorted(ts)
+
+
+class TestLogTimeStart:
+    def test_env_var_filters_trace(self, monkeypatch):
+        # MADSIM_LOG_TIME_START (ms) is the default time filter
+        # (runtime/mod.rs:349-358)
+        from madsim_tpu.models.pingpong import PingPong, state_spec
+        from madsim_tpu.runtime.trace import format_trace
+        rt = Runtime(SimConfig(n_nodes=3, time_limit=sec(5)),
+                     [PingPong(3, target=4)], state_spec())
+        _, events = rt.run_single(3, 4000, collect_events=True)
+        full = format_trace(events, 0)
+        monkeypatch.setenv("MADSIM_LOG_TIME_START", "5")
+        filtered = format_trace(events, 0)
+        assert 0 < len(filtered) < len(full)
+        explicit = format_trace(events, 0, time_start=T.ms(5))
+        assert filtered == explicit
