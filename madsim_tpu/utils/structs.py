@@ -1,0 +1,57 @@
+"""Payload word-layout helpers.
+
+Messages are fixed int32 word vectors (the typed encoding replacing the
+reference's `Box<dyn Any>` payloads, net/mod.rs:366 — see core/api.py
+`as_payload`). Protocols read/write fixed positions; these helpers keep
+those positions named and let non-integer values ride int32 words.
+
+    L = Layout("term", "prev", "commit")
+    ctx.send(dst, AE, L.pack(term=st["term"], prev=nxt, commit=c))
+    ...
+    term = payload[L.term]          # named index, still a plain int
+
+Floats travel by BITCAST (not rounding): `f32_to_word` / `word_to_f32`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Layout:
+    """Named word positions for a payload. Attribute access returns the
+    word index; `pack` builds the word list in declaration order."""
+
+    def __init__(self, *names: str):
+        assert len(set(names)) == len(names), f"duplicate fields: {names}"
+        self._names = names
+        for i, n in enumerate(names):
+            assert not hasattr(self, n), f"reserved field name: {n}"
+            setattr(self, n, i)
+
+    @property
+    def width(self) -> int:
+        return len(self._names)
+
+    def pack(self, **fields):
+        """Word list in declaration order; missing fields are 0."""
+        unknown = set(fields) - set(self._names)
+        assert not unknown, f"unknown payload fields: {sorted(unknown)}"
+        zero = jnp.asarray(0, jnp.int32)
+        return [jnp.asarray(fields.get(n, zero), jnp.int32)
+                for n in self._names]
+
+    def unpack(self, payload):
+        """dict of field -> word (positions beyond the payload are absent
+        by construction: as_payload zero-pads to cfg.payload_words)."""
+        return {n: payload[i] for i, n in enumerate(self._names)}
+
+
+def f32_to_word(x):
+    """Bitcast a float32 value into an int32 payload word (lossless)."""
+    return jnp.asarray(x, jnp.float32).view(jnp.int32)
+
+
+def word_to_f32(w):
+    """Recover the float32 from its payload word."""
+    return jnp.asarray(w, jnp.int32).view(jnp.float32)

@@ -201,3 +201,23 @@ class TestRandomTargets:
         assert (dead.sum(axis=1) == 1).all()        # exactly one victim
         victims = dead.argmax(axis=1)
         assert len(set(victims.tolist())) >= 3      # victims vary by seed
+
+
+class TestPayloadStructs:
+    def test_layout_pack_unpack_roundtrip(self):
+        import jax.numpy as jnp
+        from madsim_tpu.utils.structs import Layout
+        L = Layout("term", "prev", "commit")
+        assert (L.term, L.prev, L.commit, L.width) == (0, 1, 2, 3)
+        words = L.pack(term=7, commit=9)
+        assert [int(w) for w in words] == [7, 0, 9]
+        payload = jnp.asarray([7, 0, 9, 0], jnp.int32)
+        got = L.unpack(payload)
+        assert int(got["term"]) == 7 and int(got["commit"]) == 9
+
+    def test_float_bitcast_lossless(self):
+        import numpy as np
+        from madsim_tpu.utils.structs import f32_to_word, word_to_f32
+        vals = np.asarray([0.0, 1.5, -3.25e-7, 1e30], np.float32)
+        back = np.asarray(word_to_f32(f32_to_word(vals)))
+        np.testing.assert_array_equal(back, vals)
