@@ -1,0 +1,15 @@
+#!/bin/bash
+# Patient tunnel watcher: ONE no-timeout claim attempt (jax.devices blocks
+# until the axon service answers; killed probes risk wedging the claim, so
+# no polling loop). On success, run the bench + tuning sweep immediately.
+cd /root/repo
+echo "$(date -u +%H:%M:%S) patient watcher: blocking on device claim" >> tpu_watch.log
+python -c "import jax; d = jax.devices(); print(d, flush=True)" >> tpu_watch.log 2>&1
+rc=$?
+echo "$(date -u +%H:%M:%S) claim returned rc=$rc" >> tpu_watch.log
+if [ $rc -eq 0 ]; then
+  python bench.py > BENCH_tpu.json 2>> tpu_watch.log
+  echo "$(date -u +%H:%M:%S) bench done rc=$?" >> tpu_watch.log
+  python bench.py --sweep > BENCH_tpu_sweep.json 2>> tpu_watch.log
+  echo "$(date -u +%H:%M:%S) sweep done rc=$?" >> tpu_watch.log
+fi
