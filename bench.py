@@ -151,6 +151,81 @@ def _sweep_mode():
                               "seed_events_per_sec": round(eps, 1)}))
 
 
+_MULTIHOST_WORKER = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+jax.distributed.initialize(coordinator_address=sys.argv[2],
+                           num_processes=2, process_id=pid)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np
+from bench import _make_runtime
+from madsim_tpu.parallel.distributed import host_seed_slice, shard_global
+
+B_GLOBAL, STEPS = 1024, 256
+rt = _make_runtime()
+runner = rt._run_chunk[False]
+state = shard_global(rt, host_seed_slice(B_GLOBAL))
+state, _ = runner(state, STEPS)                      # warm/compile
+jax.block_until_ready(state.now)
+state = shard_global(rt, host_seed_slice(B_GLOBAL))
+# barrier so both processes time the same region
+jax.block_until_ready(jax.jit(lambda s: s.halted.any())(state))
+t0 = time.perf_counter()
+state, _ = runner(state, STEPS)
+halted_any = bool(jax.jit(lambda s: s.halted.any())(state))  # DCN reduction
+dt = time.perf_counter() - t0
+print(f"RESULT pid={pid} wall={dt:.4f} halted_any={halted_any}", flush=True)
+"""
+
+
+def _multihost_mode():
+    """--multihost: run the flagship workload sharded over TWO real
+    jax.distributed processes (loopback coordinator, CPU devices) and
+    report aggregate seed-events/s. This drives the actual DCN code path
+    (global array assembly + cross-process reductions) end-to-end; on a
+    single-core host the two processes share the core, so the number
+    demonstrates the path, not a speedup."""
+    import socket
+    import tempfile
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    f = tempfile.NamedTemporaryFile("w", suffix=".py", delete=False,
+                                    dir=os.path.dirname(
+                                        os.path.abspath(__file__)))
+    f.write(_MULTIHOST_WORKER)
+    f.close()
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, f.name, str(i), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=_cpu_env()) for i in range(2)]
+        outs = [p.communicate(timeout=900)[0] for p in procs]
+    finally:
+        os.unlink(f.name)
+    results = [l for o in outs for l in o.splitlines()
+               if l.startswith("RESULT")]
+    if len(results) != 2:
+        print(json.dumps({"metric": "madraft_fuzz_multihost",
+                          "error": "worker failed",
+                          "logs": [o[-500:] for o in outs]}))
+        return
+    walls = [float(r.split("wall=")[1].split()[0]) for r in results]
+    eps = 1024 * 256 / max(walls)
+    print(json.dumps({
+        "metric": "madraft_fuzz_multihost_seed_events_per_sec",
+        "value": round(eps, 1),
+        "unit": "seed*events/s (2 processes x 2 devices, loopback DCN)",
+        "processes": 2,
+    }))
+
+
 def _scaling_mode():
     """--scaling: run the sharded path at every mesh size on the virtual
     8-device CPU mesh and report per-config seed-events/s.
@@ -186,6 +261,9 @@ def _scaling_mode():
 
 
 def main():
+    if "--multihost" in sys.argv:
+        _multihost_mode()
+        return
     if "--sweep" in sys.argv:
         _sweep_mode()
         return
