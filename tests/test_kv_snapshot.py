@@ -100,3 +100,16 @@ class TestKvSnapshot:
     def test_replay_stable(self):
         rt = _rt(cfg=_cfg(time_limit=sec(4)))
         assert rt.check_determinism(seed=11, max_steps=10_000)
+
+    def test_batch_vs_single_with_compaction(self):
+        # the replay-by-seed contract must survive the round's newest
+        # machinery: sliding-window logs, digest folds, chunked snapshot
+        # transfer — seed 5 inside a chaos batch reaches bit-identical
+        # state to seed 5 run alone
+        sc = Scenario()
+        sc.at(ms(400)).kill(0)
+        sc.at(sec(2)).restart(0)
+        rt = _rt(scenario=sc, cfg=_cfg(time_limit=sec(4)))
+        batch, _ = rt.run(rt.init_batch(np.arange(8)), 30_000)
+        solo, _ = rt.run(rt.init_single(5), 30_000)
+        assert rt.fingerprints(batch)[5] == rt.fingerprints(solo)[0]
