@@ -1,0 +1,71 @@
+"""Canned chaos recipes — reusable Scenario builders.
+
+The reference's chaos tests hand-roll the same supervisor futures over and
+over (MadRaft's random_kill/random_partition loops; tonic-example's
+crash-the-server); so did this repo's test files. These builders capture
+the recurring shapes once. Each takes an optional `sc` to compose onto and
+returns it, so recipes chain:
+
+    sc = chaos.rolling_kills(rounds=4, among=range(5))
+    sc = chaos.split_brain(at=sec(2), group=[0, 1], heal_after=sec(1), sc=sc)
+"""
+
+from __future__ import annotations
+
+from ..core.types import ms, sec
+from .scenario import Scenario
+
+
+def rolling_kills(rounds: int = 4, first=ms(800), period=ms(900),
+                  down=ms(500), among=None, sc: Scenario | None = None):
+    """Kill a random eligible node every `period`, restarting it `down`
+    later — the MadRaft random_kill loop."""
+    sc = sc or Scenario()
+    for t in range(rounds):
+        sc.at(first + period * t).kill_random(among=among)
+        sc.at(first + period * t + down).restart_random(among=among)
+    return sc
+
+
+def rolling_pauses(rounds: int = 4, first=ms(800), period=ms(900),
+                   down=ms(300), among=None, sc: Scenario | None = None):
+    """Pause/resume churn: nodes freeze (clock keeps moving — leases and
+    timeouts expire around them) instead of dying."""
+    sc = sc or Scenario()
+    for t in range(rounds):
+        sc.at(first + period * t).pause_random(among=among)
+        sc.at(first + period * t + down).resume_random(among=among)
+    return sc
+
+
+def split_brain(at, group, heal_after, sc: Scenario | None = None):
+    """Partition `group` from everyone else, heal after `heal_after`."""
+    sc = sc or Scenario()
+    sc.at(at).partition(group)
+    sc.at(at + heal_after).heal()
+    return sc
+
+
+def flaky_network(at, loss: float, until, latency=None,
+                  restore_loss: float = 0.0, restore_latency=None,
+                  sc: Scenario | None = None):
+    """Degrade the network for a window: raise loss (and optionally the
+    latency range), then restore."""
+    sc = sc or Scenario()
+    sc.at(at).set_loss(loss)
+    if latency is not None:
+        sc.at(at).set_latency(*latency)
+    sc.at(until).set_loss(restore_loss)
+    if restore_latency is not None:
+        sc.at(until).set_latency(*restore_latency)
+    return sc
+
+
+def madraft_churn(servers, rounds: int = 4, first=ms(800), period=ms(900),
+                  down=ms(500), partition_at=sec(2), partition_group=(0, 1),
+                  heal_after=sec(1), sc: Scenario | None = None):
+    """The standard MadRaft fuzz mix: rolling kills over the servers plus
+    one partition/heal cycle — the shape BASELINE.md configs 2/4 use."""
+    sc = rolling_kills(rounds, first, period, down, among=servers, sc=sc)
+    return split_brain(partition_at, list(partition_group), heal_after,
+                       sc=sc)

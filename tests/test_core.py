@@ -221,3 +221,23 @@ class TestPayloadStructs:
         vals = np.asarray([0.0, 1.5, -3.25e-7, 1e30], np.float32)
         back = np.asarray(word_to_f32(f32_to_word(vals)))
         np.testing.assert_array_equal(back, vals)
+
+
+class TestChaosRecipes:
+    def test_recipes_compose_and_run(self):
+        import numpy as np
+        from madsim_tpu import SimConfig, NetConfig, ms, sec
+        from madsim_tpu.harness.simtest import run_seeds
+        from madsim_tpu.models.raft import make_raft_runtime
+        from madsim_tpu.runtime import chaos
+
+        sc = chaos.madraft_churn(servers=range(5), rounds=3)
+        sc = chaos.flaky_network(at=ms(500), loss=0.15, until=sec(2), sc=sc)
+        cfg = SimConfig(n_nodes=5, event_capacity=256, time_limit=sec(6),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(10)))
+        rt = make_raft_runtime(5, 16, n_cmds=6, scenario=sc, cfg=cfg)
+        state = run_seeds(rt, np.arange(6), max_steps=30_000)
+        assert bool(state.halted.all())
+        # the loss window actually dropped packets somewhere in the batch
+        assert int(np.asarray(state.msg_dropped).sum()) > 0
