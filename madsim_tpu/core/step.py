@@ -109,7 +109,11 @@ def make_step(
         # ---- 1. pick next event: earliest eligible deadline, random tie-break
         occupied = s.t_kind != T.EV_FREE
         tnode = jnp.clip(s.t_node, 0, cfg.n_nodes - 1)
-        parked = (s.alive[tnode] & s.paused[tnode]
+        # one-hot instead of alive[tnode]/paused[tnode]: a [C]-index gather
+        # costs ~10ns/element on TPU (it was the 2nd-hottest op in the
+        # profiled Raft step); the [C, N] compare+reduce is ~free
+        parked_nodes = s.alive & s.paused
+        parked = (sel.take1(parked_nodes, tnode)
                   & (s.t_kind != T.EV_SUPER))  # paused nodes park their events
         eligible = occupied & ~parked
         dmin, at_min, any_ev = sel.min_deadline(s.t_deadline, eligible, T.T_INF)
@@ -256,13 +260,24 @@ def make_step(
 
             w = jnp.stack(em_write)                      # [E] bool
             high_water = occupied_now + w.sum(dtype=jnp.int32)
-            # masked-off emissions scatter out of bounds and are dropped —
-            # real slots are distinct, so the scatter has no index clashes
+            # one-hot write instead of an [E]-index scatter (serializes on
+            # TPU, ~10ns/element): real slots are distinct by construction,
+            # so summing the one-hot rows yields each written value exactly
+            # once; masked-off emissions match no column and write nothing
             slots_eff = jnp.where(w, slots,
                                   jnp.asarray(cfg.event_capacity, jnp.int32))
+            slot_oh = slots_eff[:, None] == jnp.arange(
+                cfg.event_capacity, dtype=jnp.int32)     # [E, C]
+            written = slot_oh.any(0)                     # [C]
 
             def put(col, vals):
-                return col.at[slots_eff].set(jnp.stack(vals), mode="drop")
+                v = jnp.stack(vals)                      # [E] or [E, P]
+                ohi = slot_oh.astype(v.dtype)
+                if v.ndim == 1:
+                    upd = (ohi * v[:, None]).sum(0)
+                    return jnp.where(written, upd, col)
+                upd = jnp.einsum("ec,ep->cp", ohi, v)
+                return jnp.where(written[:, None], upd, col)
 
             s = s.replace(
                 t_deadline=put(s.t_deadline, em_deadline),
@@ -410,7 +425,8 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     # A <-> not-A (payload packs membership 31 nodes/word); OP_HEAL clears
     # everything
     node_ids = jnp.arange(N, dtype=jnp.int32)
-    in_a = ((payload[node_ids // 31] >> (node_ids % 31)) & 1).astype(bool)
+    words = sel.take1(payload, node_ids // 31)  # one-hot: vector-index
+    in_a = ((words >> (node_ids % 31)) & 1).astype(bool)  # gathers serialize
     cut = in_a[:, None] != in_a[None, :]
     clog_link = jnp.where(when(op == T.OP_PARTITION), cut, clog_link)
     clog_link = jnp.where(when(op == T.OP_HEAL),

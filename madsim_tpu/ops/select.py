@@ -41,6 +41,21 @@ def min_deadline(deadlines, eligible, inf):
     return dmin, at_min, any_eligible
 
 
+def take1(vec, idx):
+    """`vec[idx]` for a 1-D `vec` and any-shape integer `idx`, via a one-hot
+    masked sum. On TPU, a gather whose index operand has many elements costs
+    ~10ns PER ELEMENT (measured: the [N,N,L] invariant gather was 78% of the
+    whole Raft step); the one-hot compare+select+reduce stays on the VPU and
+    is bandwidth-trivial for the small tables this engine uses. Out-of-range
+    indices must be pre-clipped (they select nothing and return 0).
+    """
+    n = vec.shape[0]
+    oh = idx[..., None] == jnp.arange(n, dtype=jnp.int32)
+    if vec.dtype == jnp.bool_:
+        return (oh & vec).any(-1)
+    return jnp.where(oh, vec, jnp.zeros((), vec.dtype)).sum(-1)
+
+
 def first_k_free(free_mask, k: int):
     """Indices of the first k free slots (stable by index).
 

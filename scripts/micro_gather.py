@@ -1,0 +1,62 @@
+"""Microbenchmark: per-lane dynamic indexing vs one-hot masking on TPU.
+
+Times each pattern inside a lax.scan whose indices change every step
+(data-dependent, so nothing hoists), and checks that wall time scales with
+step count (guarding against the whole loop being optimized away).
+"""
+
+import time
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, C = 4096, 96
+
+
+def scan_bench(body, steps):
+    @jax.jit
+    def run(x, idx):
+        def f(carry, _):
+            x, idx = carry
+            x = body(x, idx)
+            idx = (idx + x[:, 0]) % C          # data-dependent next index
+            return (x, idx), ()
+        (x, idx), _ = jax.lax.scan(f, (x, idx), None, length=steps)
+        return x.sum() + idx.sum()
+    return run
+
+
+def onehot(idx):
+    return jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) == idx[:, None]
+
+
+x0 = jnp.asarray(np.random.randint(0, 100, (B, C)), jnp.int32)
+idx0 = jnp.asarray(np.random.randint(0, C, (B,)), jnp.int32)
+
+PATTERNS = [
+    ("elementwise [B,C]", lambda x, idx: (x * 3 + 1) % 1000),
+    ("gather take_along_axis", lambda x, idx: x + jnp.take_along_axis(
+        x, idx[:, None], axis=1)),
+    ("gather vmap r[i]", lambda x, idx: x + jax.vmap(
+        lambda r, i: r[i])(x, idx)[:, None]),
+    ("gather one-hot", lambda x, idx: x + jnp.where(
+        onehot(idx), x, 0).sum(axis=1, keepdims=True)),
+    ("scatter vmap .at[i].set", lambda x, idx: jax.vmap(
+        lambda r, i: r.at[i].set(r[0]))(x, idx)),
+    ("scatter one-hot where", lambda x, idx: jnp.where(
+        onehot(idx), x[:, :1], x)),
+]
+
+for name, body in PATTERNS:
+    rows = []
+    for steps in (128, 512):
+        fn = scan_bench(body, steps)
+        fn(x0, idx0).block_until_ready()          # compile+warm
+        t0 = time.perf_counter()
+        out = fn(x0, idx0).block_until_ready()
+        rows.append(time.perf_counter() - t0)
+    us128, us512 = rows[0] / 128 * 1e6, rows[1] / 512 * 1e6
+    print(f"{name:28s} {us128:9.2f} us/step @128  {us512:9.2f} us/step @512",
+          file=sys.stderr)
