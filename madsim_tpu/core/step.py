@@ -39,14 +39,17 @@ def _where_tree(mask, new, old):
     return jax.tree.map(lambda a, b: jnp.where(mask, a, b), new, old)
 
 
+# node-state slice/scatter via one-hot over the [N] axis: a traced node
+# index would lower to a per-lane gather/scatter under vmap, which TPU
+# executes at ~10ns per element (DESIGN.md §5) — for the log-shaped leaves
+# that alone was several ms/step
 def _slice_node(tree, node):
-    return jax.tree.map(lambda a: a[node], tree)
+    return jax.tree.map(lambda a: sel.take_row(a, node), tree)
 
 
 def _scatter_node(tree, node, new, mask):
     return jax.tree.map(
-        lambda full, val: full.at[node].set(jnp.where(mask, val, full[node])),
-        tree, new)
+        lambda full, val: sel.put_row(full, node, val, mask), tree, new)
 
 
 EMPTY_SEND = lambda P: dict(
@@ -120,12 +123,12 @@ def make_step(
         idx, picked = sel.masked_choice(k_sched, at_min)
         valid = picked & any_ev & live
 
-        ev_kind = jnp.where(valid, s.t_kind[idx], T.EV_FREE)
-        ev_node_raw = s.t_node[idx]  # may be NODE_RANDOM for supervisor ops
+        ev_kind = jnp.where(valid, sel.take1(s.t_kind, idx), T.EV_FREE)
+        ev_node_raw = sel.take1(s.t_node, idx)  # may be NODE_RANDOM (super)
         ev_node = jnp.clip(ev_node_raw, 0, cfg.n_nodes - 1)
-        ev_src = s.t_src[idx]
-        ev_tag = s.t_tag[idx]
-        ev_payload = s.t_payload[idx]
+        ev_src = sel.take1(s.t_src, idx)
+        ev_tag = sel.take1(s.t_tag, idx)
+        ev_payload = sel.take_row(s.t_payload, idx)
 
         # pop the slot; clock never runs backward (resumed nodes' past-due
         # events fire "now", the park/unpark analog of task.rs:134-137)
@@ -136,10 +139,10 @@ def make_step(
         s = s.replace(
             key=key,
             now=now,
-            t_kind=s.t_kind.at[idx].set(
-                jnp.where(valid, T.EV_FREE, s.t_kind[idx])),
-            t_deadline=s.t_deadline.at[idx].set(
-                jnp.where(valid, T.T_INF, s.t_deadline[idx])),
+            t_kind=sel.put_row(s.t_kind, idx,
+                               jnp.asarray(T.EV_FREE, jnp.int32), valid),
+            t_deadline=sel.put_row(s.t_deadline, idx,
+                                   jnp.asarray(T.T_INF, jnp.int32), valid),
         )
 
         # ---- 2. supervisor op (Handle::kill/restart/... as events) ---------
@@ -163,7 +166,8 @@ def make_step(
             s = s.replace(ext=new_ext)
 
         # ---- 3. protocol handler dispatch ---------------------------------
-        node_ok = s.alive[ev_node] & ~s.paused[ev_node]
+        node_ok = (sel.take1(s.alive, ev_node)
+                   & ~sel.take1(s.paused, ev_node))
         is_msg = valid & (ev_kind == T.EV_MSG) & node_ok
         is_timer = valid & (ev_kind == T.EV_TIMER) & node_ok
         is_init = init_node >= 0
@@ -173,8 +177,9 @@ def make_step(
         base_slice = _slice_node(s.node_state, h_node)
 
         combos = []  # (mask, ctx) pairs; masks are mutually exclusive
+        h_prog = sel.take1(node_prog_j, h_node)
         for p_idx, prog in enumerate(programs):
-            pmask = node_prog_j[h_node] == p_idx
+            pmask = h_prog == p_idx
             for hkind, run in (
                 (is_init, lambda c: prog.init(c)),
                 (is_msg, lambda c: prog.on_message(c, ev_src, ev_tag,
@@ -226,13 +231,15 @@ def make_step(
             net_keys = prng.split(k_net, 2 * max(n_sends, 1))
             em_write, em_deadline, em_kind = [], [], []
             em_node, em_tag, em_payload = [], [], []
+            src_clog = sel.take1(s.clog_node, h_node)
+            src_links = sel.take_row(s.clog_link, h_node)    # [N]
 
             for j, e in enumerate(sends):
                 dst = jnp.clip(e["dst"], 0, cfg.n_nodes - 1)
                 # network fault model: clog + loss + latency
                 # (network.rs:222-229)
-                clogged = (s.clog_node[h_node] | s.clog_node[dst]
-                           | s.clog_link[h_node, dst])
+                clogged = (src_clog | sel.take1(s.clog_node, dst)
+                           | sel.take1(src_links, dst))
                 lost = prng.bernoulli(net_keys[2 * j], s.loss)
                 latency = prng.randint(net_keys[2 * j + 1], s.lat_lo, s.lat_hi)
                 ok = e["m"] & ~clogged & ~lost
@@ -397,29 +404,31 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     t_kind = jnp.where(clear, T.EV_FREE, s.t_kind)
     t_deadline = jnp.where(clear, T.T_INF, s.t_deadline)
 
-    alive = s.alive.at[target].set(
-        jnp.where(kill & ~boot, False,
-                  jnp.where(boot, True, s.alive[target])))
-    paused = s.paused.at[target].set(
-        jnp.where(kill | boot | when(op == T.OP_RESUME), False,
-                  jnp.where(when(op == T.OP_PAUSE), True, s.paused[target])))
+    # all per-node edits below are one-hot selects, not .at[target] scatters
+    # (a traced scatter index serializes per lane on TPU — DESIGN.md §5)
+    ohT = jnp.arange(N, dtype=jnp.int32) == target          # [N]
+    alive = jnp.where(ohT & kill & ~boot, False,
+                      jnp.where(ohT & boot, True, s.alive))
+    paused = jnp.where(ohT & (kill | boot | when(op == T.OP_RESUME)), False,
+                       jnp.where(ohT & when(op == T.OP_PAUSE), True,
+                                 s.paused))
 
     # node boot/restart resets protocol state to the spec default — process
     # memory does not survive a crash. Leaves marked persistent are stable
     # storage (the FsSim analog) and DO survive.
     node_state = jax.tree.map(
-        lambda full, dflt, keep: full if keep else full.at[target].set(
-            jnp.where(boot, dflt, full[target])),
+        lambda full, dflt, keep: full if keep
+        else sel.put_row(full, target, dflt, boot),
         s.node_state, spec_default, persist_mask)
 
-    clog_node = s.clog_node.at[target].set(
-        jnp.where(when(op == T.OP_CLOG_NODE), True,
-                  jnp.where(when(op == T.OP_UNCLOG_NODE), False,
-                            s.clog_node[target])))
-    clog_link = s.clog_link.at[src_c, target].set(
-        jnp.where(when(op == T.OP_CLOG_LINK), True,
-                  jnp.where(when(op == T.OP_UNCLOG_LINK), False,
-                            s.clog_link[src_c, target])))
+    clog_node = jnp.where(ohT & when(op == T.OP_CLOG_NODE), True,
+                          jnp.where(ohT & when(op == T.OP_UNCLOG_NODE),
+                                    False, s.clog_node))
+    oh_link = ((jnp.arange(N, dtype=jnp.int32) == src_c)[:, None]
+               & ohT[None, :])
+    clog_link = jnp.where(oh_link & when(op == T.OP_CLOG_LINK), True,
+                          jnp.where(oh_link & when(op == T.OP_UNCLOG_LINK),
+                                    False, s.clog_link))
 
     # whole-matrix ops: OP_PARTITION replaces the link matrix with the cut
     # A <-> not-A (payload packs membership 31 nodes/word); OP_HEAL clears

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..core.api import Ctx, Program
 from ..core.types import ms
-from ..ops.select import take1
+from ..ops.select import put_row, take1
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
@@ -223,22 +223,21 @@ class Raft(Program):
         live = st["log_len"] - st["snap_len"]
         when = when & (live < self.L)
         widx = jnp.clip(live, 0, self.L - 1)
-        st["log_term"] = st["log_term"].at[widx].set(
-            jnp.where(when, st["term"], st["log_term"][widx]))
+        st["log_term"] = put_row(st["log_term"], widx, st["term"], when)
         for f in self.ENTRY_FIELDS:
-            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
-                jnp.where(when, vals[f], st[f"log_{f}"][widx]))
+            st[f"log_{f}"] = put_row(st[f"log_{f}"], widx, vals[f], when)
         st["log_len"] = st["log_len"] + when
-        st["match_idx"] = st["match_idx"].at[ctx.node].set(
-            jnp.where(when, st["log_len"], st["match_idx"][ctx.node]))
+        st["match_idx"] = put_row(st["match_idx"], ctx.node, st["log_len"],
+                                  when)
         return when
 
     # -- helpers ----------------------------------------------------------
     def _last_term(self, st):
         return jnp.where(
             st["log_len"] > st["snap_len"],
-            st["log_term"][jnp.clip(st["log_len"] - 1 - st["snap_len"], 0,
-                                    self.L - 1)],
+            take1(st["log_term"],
+                  jnp.clip(st["log_len"] - 1 - st["snap_len"], 0,
+                           self.L - 1)),
             st["snap_term"])
 
     def _entry_hash(self, st):
@@ -275,10 +274,10 @@ class Raft(Program):
         contrib = jnp.where(ks < shift, h * w, 0).sum()
         self._snapshot_extra(ctx, st, do, shift)
         st["snap_digest"] = jnp.where(
-            do, st["snap_digest"] * self._powP[shift] + contrib,
+            do, st["snap_digest"] * take1(self._powP, shift) + contrib,
             st["snap_digest"])
         st["snap_term"] = jnp.where(
-            do, st["log_term"][jnp.clip(shift - 1, 0, L - 1)],
+            do, take1(st["log_term"], jnp.clip(shift - 1, 0, L - 1)),
             st["snap_term"])
         st["snap_len"] = st["snap_len"] + shift
         self._shift_log(st, shift, st["log_len"] - st["snap_len"])
@@ -340,13 +339,14 @@ class Raft(Program):
             has = nxt < st["log_len"]
             prev_term = jnp.where(
                 nxt > sl,
-                st["log_term"][jnp.clip(nxt - 1 - sl, 0, L - 1)],
+                take1(st["log_term"], jnp.clip(nxt - 1 - sl, 0, L - 1)),
                 st["snap_term"])
             eidx = jnp.clip(nxt - sl, 0, L - 1)
             ae_payload = jnp.stack(
                 [st["term"], nxt, prev_term, st["commit"],
-                 st["log_term"][eidx]]
-                + [st[f"log_{f}"][eidx] for f in self.ENTRY_FIELDS]
+                 take1(st["log_term"], eidx)]
+                + [take1(st[f"log_{f}"], eidx)
+                   for f in self.ENTRY_FIELDS]
                 + [has.astype(jnp.int32)])
             ctx.send(p,
                      jnp.where(is_el, RV, jnp.where(need_is, IS, AE)),
@@ -406,7 +406,8 @@ class Raft(Program):
                                    * st["log_len"], st["next_idx"])
         st["match_idx"] = jnp.where(
             become_leader,
-            jnp.zeros((N,), jnp.int32).at[ctx.node].set(st["log_len"]),
+            jnp.where(jnp.arange(N, dtype=jnp.int32) == ctx.node,
+                      st["log_len"], 0),
             st["match_idx"])
         st["hgen"] = st["hgen"] + become_leader
         ctx.set_timer(0, T_HEARTBEAT, [st["hgen"]], when=become_leader)
@@ -431,18 +432,18 @@ class Raft(Program):
         # compare the term stored in the sliding window (slot = abs - sl)
         prev_ok = (prev <= sl) | (
             (prev <= st["log_len"])
-            & (st["log_term"][jnp.clip(prev - 1 - sl, 0, L - 1)] == prev_t))
+            & (take1(st["log_term"],
+                     jnp.clip(prev - 1 - sl, 0, L - 1)) == prev_t))
         ok = (is_ae & (term_in == st["term"])) & prev_ok & (
             ~has | (prev - sl < L))
         write = ok & has & (prev >= sl)  # can't write below the snapshot
         conflict = write & (prev < st["log_len"]) & (
-            st["log_term"][jnp.clip(prev - sl, 0, L - 1)] != e_term)
+            take1(st["log_term"], jnp.clip(prev - sl, 0, L - 1)) != e_term)
         widx = jnp.clip(prev - sl, 0, L - 1)
-        st["log_term"] = st["log_term"].at[widx].set(
-            jnp.where(write, e_term, st["log_term"][widx]))
+        st["log_term"] = put_row(st["log_term"], widx, e_term, write)
         for f in self.ENTRY_FIELDS:
-            st[f"log_{f}"] = st[f"log_{f}"].at[widx].set(
-                jnp.where(write, e_fields[f], st[f"log_{f}"][widx]))
+            st[f"log_{f}"] = put_row(st[f"log_{f}"], widx, e_fields[f],
+                                     write)
         new_len = jnp.where(
             write, jnp.where(conflict, prev + 1,
                              jnp.maximum(st["log_len"], prev + 1)),
@@ -463,7 +464,8 @@ class Raft(Program):
         want = is_is & (term_in == st["term"]) & (s_len > sl)
         inst = want & self._install_ready(ctx, st, want, payload)
         have_suffix = inst & (st["log_len"] >= s_len) & (
-            st["log_term"][jnp.clip(s_len - 1 - sl, 0, L - 1)] == s_term)
+            take1(st["log_term"],
+                  jnp.clip(s_len - 1 - sl, 0, L - 1)) == s_term)
         keep_len = jnp.where(inst,
                              jnp.where(have_suffix, st["log_len"], s_len),
                              st["log_len"])
@@ -491,16 +493,16 @@ class Raft(Program):
                   & (term_in == st["term"]))
         succ = payload[1] == 1
         mlen = payload[2]
+        old_match = take1(st["match_idx"], src)
+        old_next = take1(st["next_idx"], src)
         new_match = jnp.where(is_aer & succ,
-                              jnp.maximum(st["match_idx"][src], mlen),
-                              st["match_idx"][src])
-        st["match_idx"] = st["match_idx"].at[src].set(new_match)
-        st["next_idx"] = st["next_idx"].at[src].set(
-            jnp.where(is_aer & succ, jnp.maximum(st["next_idx"][src],
-                                                 new_match),
+                              jnp.maximum(old_match, mlen), old_match)
+        st["match_idx"] = put_row(st["match_idx"], src, new_match)
+        st["next_idx"] = put_row(
+            st["next_idx"], src,
+            jnp.where(is_aer & succ, jnp.maximum(old_next, new_match),
                       jnp.where(is_aer & ~succ,
-                                jnp.maximum(st["next_idx"][src] - 1, 0),
-                                st["next_idx"][src])))
+                                jnp.maximum(old_next - 1, 0), old_next)))
         # advance commit: majority-replicated entries of the current term
         # (§5.4.2 — never commit prior-term entries by counting). Slot k
         # holds absolute entry snap_len + k; match_idx is absolute.

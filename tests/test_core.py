@@ -51,6 +51,41 @@ class TestSelectOps:
         assert list(np.asarray(slots)) == [1, 3, 4, 0]
         assert list(np.asarray(ok)) == [True, True, True, False]
 
+    def test_take1_matches_gather(self):
+        vec = jnp.asarray([10, 20, 30, 40], jnp.int32)
+        # scalar, vector, and matrix index shapes; int and bool vecs
+        assert int(sel.take1(vec, jnp.asarray(2))) == 30
+        idx = jnp.asarray([[0, 3], [1, 1]], jnp.int32)
+        assert np.asarray(sel.take1(vec, idx)).tolist() == [[10, 40],
+                                                            [20, 20]]
+        bvec = jnp.asarray([True, False, True, False])
+        assert np.asarray(sel.take1(bvec, idx)).tolist() == [[True, False],
+                                                             [False, False]]
+
+    def test_take_row_put_row(self):
+        mat = jnp.arange(12, dtype=jnp.int32).reshape(3, 4)
+        assert np.asarray(sel.take_row(mat, jnp.asarray(1))).tolist() == \
+            [4, 5, 6, 7]
+        bmat = mat > 5
+        assert np.asarray(sel.take_row(bmat, jnp.asarray(2))).tolist() == \
+            [True, True, True, True]
+        # put_row: row write, broadcasting scalar val, mask=False no-op
+        out = sel.put_row(mat, jnp.asarray(2), jnp.asarray(-1, jnp.int32))
+        assert np.asarray(out).tolist() == [[0, 1, 2, 3], [4, 5, 6, 7],
+                                            [-1, -1, -1, -1]]
+        row = jnp.asarray([9, 9, 9, 9], jnp.int32)
+        noop = sel.put_row(mat, jnp.asarray(0), row, mask=jnp.asarray(False))
+        assert (np.asarray(noop) == np.asarray(mat)).all()
+        # 1-D mats (the Raft log columns) and masked scalar write
+        vec = jnp.asarray([1, 2, 3], jnp.int32)
+        out = sel.put_row(vec, jnp.asarray(1), jnp.asarray(7, jnp.int32),
+                          mask=jnp.asarray(True))
+        assert np.asarray(out).tolist() == [1, 7, 3]
+        # under vmap (per-lane scalar index — the engine's actual use)
+        idxs = jnp.asarray([0, 2], jnp.int32)
+        rows = jax.vmap(lambda i: sel.take_row(mat, i))(idxs)
+        assert np.asarray(rows).tolist() == [[0, 1, 2, 3], [8, 9, 10, 11]]
+
 
 def _pingpong_rt(n_nodes=3, target=5, **cfg_kw):
     cfg = SimConfig(n_nodes=n_nodes, time_limit=T.sec(30), **cfg_kw)
