@@ -406,7 +406,7 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
 
     # all per-node edits below are one-hot selects, not .at[target] scatters
     # (a traced scatter index serializes per lane on TPU — DESIGN.md §5)
-    ohT = jnp.arange(N, dtype=jnp.int32) == target          # [N]
+    ohT = sel.row_onehot(N, target)                         # [N]
     alive = jnp.where(ohT & kill & ~boot, False,
                       jnp.where(ohT & boot, True, s.alive))
     paused = jnp.where(ohT & (kill | boot | when(op == T.OP_RESUME)), False,
@@ -424,8 +424,7 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     clog_node = jnp.where(ohT & when(op == T.OP_CLOG_NODE), True,
                           jnp.where(ohT & when(op == T.OP_UNCLOG_NODE),
                                     False, s.clog_node))
-    oh_link = ((jnp.arange(N, dtype=jnp.int32) == src_c)[:, None]
-               & ohT[None, :])
+    oh_link = sel.row_onehot(N, src_c)[:, None] & ohT[None, :]
     clog_link = jnp.where(oh_link & when(op == T.OP_CLOG_LINK), True,
                           jnp.where(oh_link & when(op == T.OP_UNCLOG_LINK),
                                     False, s.clog_link))
@@ -433,9 +432,8 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     # whole-matrix ops: OP_PARTITION replaces the link matrix with the cut
     # A <-> not-A (payload packs membership 31 nodes/word); OP_HEAL clears
     # everything
-    node_ids = jnp.arange(N, dtype=jnp.int32)
-    words = sel.take1(payload, node_ids // 31)  # one-hot: vector-index
-    in_a = ((words >> (node_ids % 31)) & 1).astype(bool)  # gathers serialize
+    words = sel.take1(payload, ids // 31)     # one-hot: vector-index
+    in_a = ((words >> (ids % 31)) & 1).astype(bool)       # gathers serialize
     cut = in_a[:, None] != in_a[None, :]
     clog_link = jnp.where(when(op == T.OP_PARTITION), cut, clog_link)
     clog_link = jnp.where(when(op == T.OP_HEAL),

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..core.api import Ctx, Program
 from ..core.types import ms
-from ..ops.select import put_row, take1
+from ..ops.select import put_row, row_onehot, take1
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
@@ -406,8 +406,7 @@ class Raft(Program):
                                    * st["log_len"], st["next_idx"])
         st["match_idx"] = jnp.where(
             become_leader,
-            jnp.where(jnp.arange(N, dtype=jnp.int32) == ctx.node,
-                      st["log_len"], 0),
+            jnp.where(row_onehot(N, ctx.node), st["log_len"], 0),
             st["match_idx"])
         st["hgen"] = st["hgen"] + become_leader
         ctx.set_timer(0, T_HEARTBEAT, [st["hgen"]], when=become_leader)

@@ -56,14 +56,17 @@ def take1(vec, idx):
     return jnp.where(oh, vec, jnp.zeros((), vec.dtype)).sum(-1)
 
 
-def _row_onehot(n, idx):
+def row_onehot(n, idx):
+    """bool[n] with True at `idx` (the building block of take_row/put_row;
+    use it directly when composing custom one-hot updates so the TPU
+    gather-avoidance semantics live in one place)."""
     return jnp.arange(n, dtype=jnp.int32) == idx
 
 
 def take_row(mat, idx):
     """`mat[idx]` for mat[R, ...] and a SCALAR traced idx, via one-hot
     (same TPU rationale as take1; under vmap the scalar is per-lane)."""
-    oh = _row_onehot(mat.shape[0], idx).reshape(
+    oh = row_onehot(mat.shape[0], idx).reshape(
         (mat.shape[0],) + (1,) * (mat.ndim - 1))
     if mat.dtype == jnp.bool_:
         return (oh & mat).any(0)
@@ -73,7 +76,7 @@ def take_row(mat, idx):
 def put_row(mat, idx, val, mask=True):
     """`mat.at[idx].set(val)` where `mask` holds, via one-hot select.
     `val` broadcasts against one row; out-of-range idx writes nothing."""
-    oh = _row_onehot(mat.shape[0], idx).reshape(
+    oh = row_onehot(mat.shape[0], idx).reshape(
         (mat.shape[0],) + (1,) * (mat.ndim - 1))
     return jnp.where(oh & mask, val, mat)
 

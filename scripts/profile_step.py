@@ -1,0 +1,70 @@
+"""Profile the flagship bench step on the live device and print the top
+HLO ops by self-time.
+
+Usage: python scripts/profile_step.py [steps]
+Captures a jax.profiler device trace of one timed chunk (default 64
+steps, B=4096 — the bench configuration) and aggregates the device
+plane's XLA-op events by name. This is the method that produced the
+round-2 findings in DESIGN.md §5 (gather serialization); keep using it
+after engine changes — CPU microbenchmarks mislead (scripts/micro_gather.py).
+"""
+import collections
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    import numpy as np
+    import jax
+    from bench import _make_runtime
+
+    rt = _make_runtime()
+    runner = rt._run_chunk[False]
+    state = rt.init_batch(np.arange(4096))
+    state, _ = runner(state, steps)          # compile + warm
+    jax.block_until_ready(state.now)
+
+    tmp = tempfile.mkdtemp(prefix="madsim_prof_")
+    with jax.profiler.trace(tmp):
+        state, _ = runner(state, steps)
+        jax.block_until_ready(state.now)
+
+    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+    assert paths, f"no xplane under {tmp}"
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # ships with baked-in TF
+    xspace = xplane_pb2.XSpace()
+    with open(paths[0], "rb") as f:
+        xspace.ParseFromString(f.read())
+
+    for plane in xspace.planes:
+        if not any(k in plane.name.lower() for k in ("tpu", "device", "gpu")):
+            continue
+        meta = {m.id: m.name for m in plane.event_metadata.values()}
+        tot = collections.Counter()
+        n = collections.Counter()
+        # aggregate op lines only — a device plane can also carry step/
+        # framework marker lines whose durations would double-count
+        lines = [l for l in plane.lines if "XLA Ops" in l.name] \
+            or list(plane.lines)
+        for line in lines:
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                tot[name] += ev.duration_ps
+                n[name] += 1
+        if not tot:
+            continue
+        total = sum(tot.values())
+        print(f"== plane: {plane.name}  total {total/1e12*1000:.2f} ms "
+              f"(sum of event durations; {steps} steps)")
+        for name, ps in tot.most_common(25):
+            print(f"  {ps/total*100:5.1f}%  {ps/1e9:9.3f} ms  x{n[name]:<6d} {name[:110]}")
+        print()
+
+
+if __name__ == "__main__":
+    main()
