@@ -151,10 +151,17 @@ class Raft(Program):
                  heartbeat_every=ms(50), propose_every=ms(100),
                  majority_override: int | None = None,
                  n_peers: int | None = None,
+                 peer_base: int = 0,
                  compact_threshold: int = 0):
         self.n = n_nodes
-        # raft peers occupy nodes [0, n_peers); the rest of the cluster
-        # (e.g. KV clients) never votes, replicates, or receives broadcasts
+        # raft peers occupy nodes [peer_base, peer_base + n_peers); the rest
+        # of the cluster (KV clients, other raft groups in a multi-group
+        # deployment like models/shard_kv.py) never votes, replicates, or
+        # receives broadcasts. match_idx/next_idx stay [N]-wide and indexed
+        # by absolute node id; rows outside the group are never written
+        # (AER only arrives from members), so the commit count over all N
+        # still counts only group members.
+        self.base = peer_base
         self.npeers = n_peers if n_peers is not None else n_nodes
         self.L = log_capacity
         self.n_cmds = n_cmds
@@ -177,6 +184,11 @@ class Raft(Program):
     # -- subclass hooks ---------------------------------------------------
     def _propose_fields(self, ctx, st):
         return {"cmd": ctx.node * 65536 + st["nprop"]}
+
+    def _can_propose(self, ctx, st):
+        """Gate for the leader's self-propose tick (beyond being leader).
+        CfgRaft throttles config proposals through this."""
+        return st["nprop"] < self.n_cmds
 
     def _on_leader_commit(self, ctx, st, prev_commit, is_aer):
         pass
@@ -333,7 +345,7 @@ class Raft(Program):
         is_payload = jnp.stack(
             [st["term"], sl, st["snap_term"], st["snap_digest"]]
             + list(extra) + [zero] * pad)
-        for p in range(self.npeers):
+        for p in range(self.base, self.base + self.npeers):
             nxt = st["next_idx"][p]
             need_is = nxt < sl
             has = nxt < st["log_len"]
@@ -357,8 +369,7 @@ class Raft(Program):
 
         # self-proposing client: leaders append a fresh command
         is_pr = tag == T_PROPOSE
-        can = (is_pr & (st["role"] == LEADER)
-               & (st["nprop"] < self.n_cmds))
+        can = is_pr & (st["role"] == LEADER) & self._can_propose(ctx, st)
         appended = self._append(ctx, st, can, self._propose_fields(ctx, st))
         st["nprop"] = st["nprop"] + appended
         ctx.set_timer(self.prop, T_PROPOSE, [0], when=is_pr)

@@ -1,0 +1,619 @@
+"""ShardKV — multi-group Raft with reconfiguration and shard migration.
+
+The MadRaft suite's hardest lab (shardkv: a sharded, linearizable KV store
+over MULTIPLE Raft groups with live shard movement) as a vectorizable state
+machine. Nothing in the reference implements this — madsim only provides the
+simulator MadRaft's labs run on — so this model demonstrates the framework
+carrying a workload at the top of the reference ecosystem's difficulty
+range: three+ independent Raft groups in one simulated cluster, a
+raft-replicated configuration service, cross-group data handoff with
+config-number fencing, and client routing that chases the configuration.
+
+Cluster layout (node ids):
+  [0, RC)                    controller group — CfgRaft (config service)
+  [RC + g*RG, RC+(g+1)*RG)   kv group g in [0, G) — ShardServer
+  [RC + G*RG, N)             clients — ShardClient
+
+Shards: key k belongs to shard k % S. A configuration is one int32 word
+packing 3 bits of owner-group per shard (config 0 = nothing assigned; the
+controller's first proposal creates config 1). Configurations are processed
+by every group STRICTLY in sequence (my_cfg -> my_cfg+1), the property the
+MadRaft lab tests enforce.
+
+Migration protocol (all through the groups' Raft logs, so every replica of
+a group transitions identically):
+  1. controller leader self-proposes OP_NEWCFG entries (initial assignment,
+     then single random shard moves) — configs are its committed log.
+  2. each kv-group leader polls CFGQ(my_cfg+1); any controller node answers
+     CFGR from its APPLIED config history.
+  3. the leader proposes OP_CFG(num, asn). Applying it is the pivot: lost
+     shards freeze their data (kv image + per-shard client sessions) into
+     an outgoing buffer stamped out_num[s]=num and stop serving; gained
+     shards (beyond config 1) become not-ready and record the previous
+     owner group.
+  4. the new owner's leader sends PULL(s, num); any node of the old group
+     whose frozen buffer matches num exactly answers PULLR with the whole
+     shard image (keys of s + session rows — fits one payload at model
+     scale; bulk shards would chunk over net/streaming like RaftKv's
+     InstallSnapshot does).
+  5. the puller replicates the image THROUGH ITS OWN LOG as OP_INS_KV /
+     OP_INS_SES entries fenced by (shard, num), closed by OP_INS_DONE which
+     flips the shard ready. Client commands for a shard are accepted only
+     when owned AND ready, so the handoff has no dual-serving window: the
+     old group stops at its OP_CFG apply, the new group starts only after
+     an image frozen at that very point is installed.
+
+Exactly-once across moves: the per-(client, shard) session table rides the
+shard image, so a retry that lands on the new owner still dedups. Client
+call ids stay monotonic per client (see RaftKv's rationale).
+
+Safety evidence: per-group Raft invariants (election safety + prefix digest
+chains) checked every event via compose_invariants, and client histories
+checked per-key with the native linearizability checker — across kills,
+restarts, partitions, loss, and live migrations. A cross-group "unique
+ready owner" invariant is deliberately NOT asserted: a lagging follower of
+the old group legitimately still believes it owns a shard until it applies
+the OP_CFG entry; safety lives in the serving gates (leader + applied
+state), which the linearizability check validates end to end.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.api import Ctx, Program
+from ..core.types import ms
+from ..ops.select import take_row
+from . import raft as R
+
+# log-entry ops
+OP_PUT, OP_GET = 1, 2
+OP_CFG, OP_INS_KV, OP_INS_SES, OP_INS_DONE, OP_NEWCFG = 3, 4, 5, 6, 7
+# message tags (1-4, 9 are raft; 5/6 shared with raft_kv's CMD/CRSP)
+CMD, CRSP = 5, 6
+CFGQ, CFGR, PULL, PULLR, CWRONG = 11, 12, 13, 14, 15
+# timer tags (1-3 raft, 4/5 shared with raft_kv's client)
+T_NEW, T_RETRY, T_CFGPOLL = 4, 5, 6
+
+FIELDS = ("op", "key", "val", "client", "rtag")
+MAXCFG_BITS = 5          # config numbers pack into 5 bits in OP_INS_SES.rtag
+GRP_BITS = 3             # owner group packs into 3 bits per shard
+
+
+def grp_of(asn, s):
+    """Owner group of shard `s` under assignment word `asn` (s may be
+    traced)."""
+    return (asn >> (GRP_BITS * s)) & ((1 << GRP_BITS) - 1)
+
+
+def shard_state_spec(n_nodes, log_capacity, *, n_keys, n_shards, n_groups,
+                     n_clients, max_cfg, n_ops):
+    z = jnp.asarray(0, jnp.int32)
+    K, S, NC = n_keys, n_shards, n_clients
+    extra = dict(
+        # ---- controller state machine (applied; persists) ---------------
+        cfg_n=z,
+        cfg_hist=jnp.zeros((max_cfg + 1,), jnp.int32),   # [0] = invalid
+        # ---- kv-server state machine (applied; persists) ----------------
+        kv=jnp.zeros((K,), jnp.int32),
+        applied=z,
+        my_cfg=z,
+        my_asn=z,
+        ready=z,                                  # bitmask over shards
+        src_grp=jnp.full((S,), -1, jnp.int32),    # pull-from group per shard
+        sess_rtag=jnp.zeros((NC, S), jnp.int32),  # per-(client, shard) dedup
+        sess_val=jnp.zeros((NC, S), jnp.int32),
+        out_num=jnp.full((S,), -1, jnp.int32),    # frozen-at config number
+        out_kv=jnp.zeros((S, K), jnp.int32),
+        out_rtag=jnp.zeros((S, NC), jnp.int32),
+        out_val=jnp.zeros((S, NC), jnp.int32),
+        # ---- client bookkeeping (volatile) ------------------------------
+        cl_cfg=z, cl_asn=z,
+        c_target=z, c_id=z, c_op=z, c_key=z, c_val=z, c_opn=z, c_wait=z,
+        h_op=jnp.zeros((n_ops,), jnp.int32),
+        h_key=jnp.zeros((n_ops,), jnp.int32),
+        h_val=jnp.zeros((n_ops,), jnp.int32),
+        h_inv=jnp.full((n_ops,), -1, jnp.int32),
+        h_resp=jnp.full((n_ops,), -1, jnp.int32),
+    )
+    return R.state_spec(n_nodes, log_capacity, FIELDS, extra)
+
+
+def shard_persist_spec():
+    keep = ("cfg_n", "cfg_hist", "kv", "applied", "my_cfg", "my_asn",
+            "ready", "src_grp", "sess_rtag", "sess_val", "out_num",
+            "out_kv", "out_rtag", "out_val")
+    vol = ("cl_cfg", "cl_asn", "c_target", "c_id", "c_op", "c_key", "c_val",
+           "c_opn", "c_wait", "h_op", "h_key", "h_val", "h_inv", "h_resp")
+    mask = R.persist_spec(FIELDS, {k: None for k in keep + vol})
+    mask.update({k: True for k in keep})
+    mask.update({k: False for k in vol})
+    return mask
+
+
+def _noop_on_become_leader(self, ctx, st, become_leader):
+    # current-term no-op entry so a new leader can advance commit over
+    # inherited entries (§5.4.2) — same rationale as RaftKv
+    z = jnp.asarray(0, jnp.int32)
+    self._append(ctx, st, become_leader & (st["commit"] < st["log_len"]),
+                 {f: z for f in FIELDS})
+
+
+class CfgRaft(R.Raft):
+    """The configuration service: a Raft group whose committed log IS the
+    sequence of cluster configurations (the shardctrler analog)."""
+
+    ENTRY_FIELDS = FIELDS
+
+    def __init__(self, n_nodes, log_capacity, *, rc, n_groups, n_shards,
+                 max_cfg, **kw):
+        super().__init__(n_nodes, log_capacity, n_cmds=max_cfg,
+                         n_peers=rc, peer_base=0, **kw)
+        self.G, self.S, self.maxcfg = n_groups, n_shards, max_cfg
+
+    _on_become_leader = _noop_on_become_leader
+
+    def _can_propose(self, ctx, st):
+        # one config in flight at a time: propose only when everything this
+        # node has appended is already applied (paces moves so groups can
+        # keep up, and makes each proposal read the latest applied config).
+        # The budget is the APPLIED config count, not nprop — nprop is
+        # per-leader-stint and would let every new controller leader mint
+        # max_cfg more configs after a crash
+        return (st["cfg_n"] < self.maxcfg) & (st["applied"] >= st["log_len"])
+
+    def _propose_fields(self, ctx, st):
+        cur = st["cfg_hist"][jnp.clip(st["cfg_n"], 0, self.maxcfg)]
+        # config 1: random initial spread; later: move one random shard
+        init_asn = jnp.asarray(0, jnp.int32)
+        for s in range(self.S):
+            init_asn = init_asn | (ctx.randint(0, self.G - 1)
+                                   << (GRP_BITS * s))
+        mv_s = ctx.randint(0, self.S - 1)
+        mv_g = ctx.randint(0, self.G - 1)
+        moved = ((cur & ~(((1 << GRP_BITS) - 1) << (GRP_BITS * mv_s)))
+                 | (mv_g << (GRP_BITS * mv_s)))
+        asn = jnp.where(st["cfg_n"] == 0, init_asn, moved)
+        z = jnp.asarray(0, jnp.int32)
+        return dict(op=jnp.asarray(OP_NEWCFG, jnp.int32), key=z, val=asn,
+                    client=z, rtag=z)
+
+    def _on_commit_progress(self, ctx: Ctx, st, active):
+        # apply committed OP_NEWCFG entries into the config history
+        for _ in range(2):
+            k = st["applied"]
+            can = active & (k < st["commit"]) & (k >= st["snap_len"])
+            slot = jnp.clip(k - st["snap_len"], 0, self.L - 1)
+            # entries past the budget apply as no-ops (NEVER overwrite a
+            # config number that may already have been served): distinct
+            # leaders can each have one proposal in flight, so the append
+            # gate alone cannot bound the committed count
+            is_cfg = (can & (st["log_op"][slot] == OP_NEWCFG)
+                      & (st["cfg_n"] < self.maxcfg))
+            nxt = jnp.clip(st["cfg_n"] + 1, 0, self.maxcfg)
+            st["cfg_hist"] = st["cfg_hist"].at[nxt].set(
+                jnp.where(is_cfg, st["log_val"][slot], st["cfg_hist"][nxt]))
+            st["cfg_n"] = jnp.where(is_cfg, nxt, st["cfg_n"])
+            st["applied"] = st["applied"] + can
+
+    def _extra_message(self, ctx: Ctx, st, src, tag, payload):
+        # CFGQ [want] -> CFGR [num, asn]: any node answers from its APPLIED
+        # history (num = min(want, cfg_n); askers ignore what they didn't
+        # ask for). Answering from followers keeps the config service
+        # available while the controller group elects.
+        is_q = tag == CFGQ
+        num = jnp.clip(jnp.minimum(payload[0], st["cfg_n"]), 0, self.maxcfg)
+        ctx.send(src, CFGR, [num, st["cfg_hist"][num]], when=is_q)
+
+
+class ShardServer(R.Raft):
+    """One kv group's Raft peer, serving shard-gated client commands and
+    migrating shards by config number (see module docstring)."""
+
+    ENTRY_FIELDS = FIELDS
+
+    def __init__(self, n_nodes, log_capacity, *, gid, rc, rg, n_groups,
+                 n_keys, n_shards, n_clients, max_cfg,
+                 cfg_poll=ms(60), apply_per_event=3, **kw):
+        super().__init__(n_nodes, log_capacity, n_cmds=0,
+                         n_peers=rg, peer_base=rc + gid * rg, **kw)
+        self.gid, self.rc, self.rg, self.G = gid, rc, rg, n_groups
+        self.K, self.S, self.NC = n_keys, n_shards, n_clients
+        self.maxcfg = max_cfg
+        self.cfg_poll = cfg_poll
+        self.apply_per_event = apply_per_event
+        self.clients_base = rc + n_groups * rg
+        self.Ks = n_keys // n_shards
+        assert n_keys % n_shards == 0, "keys must spread evenly over shards"
+        assert max_cfg < (1 << MAXCFG_BITS)
+        assert n_groups <= (1 << GRP_BITS)
+
+    _on_become_leader = _noop_on_become_leader
+
+    def _propose_fields(self, ctx, st):
+        z = jnp.asarray(0, jnp.int32)
+        return {f: z for f in FIELDS}   # never self-proposes (n_cmds=0)
+
+    def _owns(self, st, s):
+        """Applied-state serving gate for shard s (may be traced)."""
+        return ((st["my_cfg"] >= 1)
+                & (grp_of(st["my_asn"], s) == self.gid)
+                & ((st["ready"] >> s) & 1).astype(bool))
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self, ctx: Ctx):
+        super().init(ctx)
+        ctx.set_timer(ctx.randint(0, self.cfg_poll), T_CFGPOLL, [0])
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        super().on_timer(ctx, tag, payload)
+        st = dict(ctx.state)
+        is_poll = tag == T_CFGPOLL
+        leader = st["role"] == R.LEADER
+        # poll the next config from a random controller node
+        ctx.send(ctx.randint(0, self.rc - 1), CFGQ, [st["my_cfg"] + 1],
+                 when=is_poll & leader)
+        # pull every owned-but-not-ready shard from its previous owner,
+        # rotating through the old group's members (stateless, like the
+        # InstallSnapshot chunk rotation)
+        for s in range(self.S):
+            need = (is_poll & leader & (st["my_cfg"] >= 1)
+                    & (grp_of(st["my_asn"], s) == self.gid)
+                    & (((st["ready"] >> s) & 1) == 0)
+                    & (st["src_grp"][s] >= 0))
+            member = (ctx.now // self.cfg_poll + s) % self.rg
+            tgt = self.rc + st["src_grp"][s] * self.rg + member
+            ctx.send(tgt, PULL, [s, st["my_cfg"]], when=need)
+        ctx.set_timer(self.cfg_poll, T_CFGPOLL, [0], when=is_poll)
+        ctx.state = st
+
+    # -- the apply loop ----------------------------------------------------
+    # Indexing note: the traced indices below (kv[key], sess[cid, s],
+    # out_*[ps]) are SCALAR per lane — the cheap case on TPU (DESIGN.md §5:
+    # scalar-per-lane dynamic indices lower to one dynamic-slice each; it
+    # is many-element index VECTORS that serialize at ~10ns/element, and
+    # none appear here).
+    def _on_commit_progress(self, ctx: Ctx, st, active):
+        L, K, S, NC = self.L, self.K, self.S, self.NC
+        for _ in range(self.apply_per_event):
+            k = st["applied"]
+            can = active & (k < st["commit"]) & (k >= st["snap_len"])
+            slot = jnp.clip(k - st["snap_len"], 0, L - 1)
+            op = st["log_op"][slot]
+            key = jnp.clip(st["log_key"][slot], 0, K - 1)
+            val = st["log_val"][slot]
+            client = st["log_client"][slot]
+            rtag = st["log_rtag"][slot]
+            cid = jnp.clip(client - self.clients_base, 0, NC - 1)
+            s_of_key = key % S
+
+            # client PUT/GET — only while the shard is owned AND ready at
+            # APPLY time (an OP_CFG between append and apply revokes it)
+            is_cli = can & ((op == OP_PUT) | (op == OP_GET))
+            valid = is_cli & self._owns(st, s_of_key)
+            do_put = valid & (op == OP_PUT)
+            st["kv"] = st["kv"].at[key].set(
+                jnp.where(do_put, val, st["kv"][key]))
+            result = st["kv"][key]
+            st["sess_rtag"] = st["sess_rtag"].at[cid, s_of_key].set(
+                jnp.where(valid, rtag, st["sess_rtag"][cid, s_of_key]))
+            st["sess_val"] = st["sess_val"].at[cid, s_of_key].set(
+                jnp.where(valid, result, st["sess_val"][cid, s_of_key]))
+            # one reply slot: OK with the result, or wrong-group so the
+            # client refreshes its config
+            ctx.send(client, jnp.where(valid, CRSP, CWRONG),
+                     [rtag, result],
+                     when=is_cli & (st["role"] == R.LEADER))
+
+            # OP_CFG(num=key', asn=val): the migration pivot. Entries carry
+            # key=num directly (not clipped to K).
+            num = st["log_key"][slot]
+            is_cfg = can & (op == OP_CFG) & (num == st["my_cfg"] + 1)
+            asn_new = val
+            for s in range(S):
+                old = (st["my_cfg"] >= 1) & (grp_of(st["my_asn"], s)
+                                             == self.gid)
+                new = grp_of(asn_new, s) == self.gid
+                lost = is_cfg & old & ~new
+                gained = is_cfg & new & ~old
+                # freeze outgoing shard data at the pivot
+                st["out_kv"] = st["out_kv"].at[s].set(
+                    jnp.where(lost, st["kv"], st["out_kv"][s]))
+                st["out_rtag"] = st["out_rtag"].at[s].set(
+                    jnp.where(lost, st["sess_rtag"][:, s],
+                              st["out_rtag"][s]))
+                st["out_val"] = st["out_val"].at[s].set(
+                    jnp.where(lost, st["sess_val"][:, s], st["out_val"][s]))
+                st["out_num"] = st["out_num"].at[s].set(
+                    jnp.where(lost, num, st["out_num"][s]))
+                # gained at config 1 = initial assignment (nothing to pull)
+                st["ready"] = jnp.where(
+                    lost, st["ready"] & ~(1 << s),
+                    jnp.where(gained & (num == 1), st["ready"] | (1 << s),
+                              jnp.where(gained, st["ready"] & ~(1 << s),
+                                        st["ready"])))
+                st["src_grp"] = st["src_grp"].at[s].set(
+                    jnp.where(gained & (num > 1),
+                              grp_of(st["my_asn"], s), st["src_grp"][s]))
+            st["my_cfg"] = jnp.where(is_cfg, num, st["my_cfg"])
+            st["my_asn"] = jnp.where(is_cfg, asn_new, st["my_asn"])
+
+            # OP_INS_* — install a pulled shard image, fenced by (s, num)
+            ins_s = jnp.clip(st["log_key"][slot], 0, S - 1)   # SES/DONE key
+            not_ready = (((st["ready"] >> ins_s) & 1) == 0)
+            is_ikv = (can & (op == OP_INS_KV) & (rtag == st["my_cfg"])
+                      & (((st["ready"] >> s_of_key) & 1) == 0))
+            st["kv"] = st["kv"].at[key].set(
+                jnp.where(is_ikv, val, st["kv"][key]))
+            is_ses = (can & (op == OP_INS_SES)
+                      & ((rtag & ((1 << MAXCFG_BITS) - 1)) == st["my_cfg"])
+                      & not_ready)
+            st["sess_rtag"] = st["sess_rtag"].at[cid, ins_s].set(
+                jnp.where(is_ses, rtag >> MAXCFG_BITS,
+                          st["sess_rtag"][cid, ins_s]))
+            st["sess_val"] = st["sess_val"].at[cid, ins_s].set(
+                jnp.where(is_ses, val, st["sess_val"][cid, ins_s]))
+            is_done = (can & (op == OP_INS_DONE) & (rtag == st["my_cfg"])
+                       & not_ready
+                       & (grp_of(st["my_asn"], ins_s) == self.gid))
+            st["ready"] = jnp.where(is_done, st["ready"] | (1 << ins_s),
+                                    st["ready"])
+
+            st["applied"] = st["applied"] + can
+
+    # -- messages ----------------------------------------------------------
+    def _extra_message(self, ctx: Ctx, st, src, tag, payload):
+        L, S, NC, Ks = self.L, self.S, self.NC, self.Ks
+        leader = st["role"] == R.LEADER
+        live = st["log_len"] - st["snap_len"]
+        ks = jnp.arange(L, dtype=jnp.int32)
+
+        # ---- CFGR [num, asn]: advance to the next config ----------------
+        is_cfgr = tag == CFGR
+        num, asn = payload[0], payload[1]
+        owned_all_ready = jnp.ones((), bool)
+        for s in range(S):
+            owned = (st["my_cfg"] >= 1) & (grp_of(st["my_asn"], s)
+                                           == self.gid)
+            owned_all_ready = owned_all_ready & (
+                ~owned | ((st["ready"] >> s) & 1).astype(bool))
+        cfg_pending = ((st["log_op"] == OP_CFG) & (st["log_key"] == num)
+                       & (ks < live)).any()
+        adv = (is_cfgr & leader & (num == st["my_cfg"] + 1)
+               & owned_all_ready & ~cfg_pending)
+        self._append(ctx, st, adv,
+                     dict(op=jnp.asarray(OP_CFG, jnp.int32), key=num,
+                          val=asn, client=jnp.asarray(0, jnp.int32),
+                          rtag=jnp.asarray(0, jnp.int32)))
+
+        # ---- CMD [rtag, op, key, val] from a client ---------------------
+        is_cmd = tag == CMD
+        rtag, cop = payload[0], payload[1]
+        ckey = jnp.clip(payload[2], 0, self.K - 1)
+        cval = payload[3]
+        s_of = ckey % S
+        cid = jnp.clip(src - self.clients_base, 0, NC - 1)
+        owns = self._owns(st, s_of)
+        sess_hit = st["sess_rtag"][cid, s_of] == rtag
+        stale = rtag < st["sess_rtag"][cid, s_of]
+        # in-flight dedup covers UNAPPLIED entries only. Unlike RaftKv,
+        # an applied entry here may have executed as a no-op (ownership
+        # revoked by an OP_CFG between append and apply) WITHOUT touching
+        # the session table — counting it as pending would drop the
+        # client's retries forever; re-appending is the correct replay.
+        unapplied = ks >= (st["applied"] - st["snap_len"])
+        pending = ((st["log_rtag"] == rtag) & (st["log_client"] == src)
+                   & (ks < live) & unapplied).any()
+        self._append(ctx, st,
+                     is_cmd & leader & owns & ~sess_hit & ~stale & ~pending,
+                     dict(op=cop, key=ckey, val=cval, client=src, rtag=rtag))
+        # dedup hit answers from the session; wrong-group redirects — one
+        # shared reply slot, mutually exclusive conditions
+        hit = is_cmd & leader & owns & sess_hit
+        wrong = is_cmd & leader & ~owns
+        ctx.send(src, jnp.where(wrong, CWRONG, CRSP),
+                 [rtag, st["sess_val"][cid, s_of]], when=hit | wrong)
+
+        # ---- PULL [s, num]: hand a frozen shard image out ---------------
+        is_pull = tag == PULL
+        ps = jnp.clip(payload[0], 0, S - 1)
+        pnum = payload[1]
+        have = is_pull & (st["out_num"][ps] == pnum)
+        okv = take_row(st["out_kv"], ps)          # [K]
+        kvals = [okv[ps + p * S] for p in range(Ks)]
+        ortag = take_row(st["out_rtag"], ps)      # [NC]
+        oval = take_row(st["out_val"], ps)
+        ctx.send(src, PULLR,
+                 [ps, pnum] + kvals + list(ortag) + list(oval), when=have)
+
+        # ---- PULLR: replicate the image through our own log -------------
+        is_pr = tag == PULLR
+        rs = jnp.clip(payload[0], 0, S - 1)
+        rnum = payload[1]
+        ins_pending = ((st["log_op"] == OP_INS_DONE) & (st["log_key"] == rs)
+                       & (st["log_rtag"] == rnum) & (ks < live)).any()
+        take = (is_pr & leader & (rnum == st["my_cfg"])
+                & (grp_of(st["my_asn"], rs) == self.gid)
+                & (((st["ready"] >> rs) & 1) == 0) & ~ins_pending)
+        z = jnp.asarray(0, jnp.int32)
+        for p in range(Ks):
+            self._append(ctx, st, take, dict(
+                op=jnp.asarray(OP_INS_KV, jnp.int32), key=rs + p * S,
+                val=payload[2 + p], client=z, rtag=rnum))
+        for c in range(NC):
+            self._append(ctx, st, take, dict(
+                op=jnp.asarray(OP_INS_SES, jnp.int32), key=rs,
+                val=payload[2 + Ks + NC + c],
+                client=jnp.asarray(self.clients_base + c, jnp.int32),
+                rtag=(payload[2 + Ks + c] << MAXCFG_BITS) | rnum))
+        self._append(ctx, st, take, dict(
+            op=jnp.asarray(OP_INS_DONE, jnp.int32), key=rs, val=z,
+            client=z, rtag=rnum))
+
+
+class ShardClient(Program):
+    """Closed-loop client routing by its cached configuration; refreshes the
+    config on wrong-group replies and timeouts, then retries the SAME call
+    id (exactly-once is the server's session table's job)."""
+
+    def __init__(self, *, rc, rg, n_groups, n_shards, n_keys, n_ops,
+                 max_cfg, timeout=ms(80), think=ms(10)):
+        self.rc, self.rg, self.G = rc, rg, n_groups
+        self.S, self.K, self.O = n_shards, n_keys, n_ops
+        self.maxcfg = max_cfg
+        self.timeout, self.think = timeout, think
+
+    def _refresh(self, ctx, when):
+        ctx.send(ctx.randint(0, self.rc - 1), CFGQ, [self.maxcfg],
+                 when=when)
+
+    def _issue(self, ctx, st, when):
+        g = grp_of(st["cl_asn"], st["c_key"] % self.S)
+        st["c_target"] = jnp.where(
+            when, self.rc + g * self.rg + ctx.randint(0, self.rg - 1),
+            st["c_target"])
+        ctx.send(st["c_target"], CMD,
+                 [st["c_id"], st["c_op"], st["c_key"], st["c_val"]],
+                 when=when)
+        ctx.set_timer(self.timeout, T_RETRY, [st["c_id"]], when=when)
+
+    def init(self, ctx: Ctx):
+        self._refresh(ctx, True)
+        ctx.set_timer(ctx.randint(ms(5), ms(30)), T_NEW, [0])
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        routed = st["cl_cfg"] >= 1
+        start = ((tag == T_NEW) & (st["c_wait"] == 0)
+                 & (st["c_opn"] < self.O) & routed)
+        # no config yet: ask again and come back
+        self._refresh(ctx, (tag == T_NEW) & ~routed)
+        ctx.set_timer(self.think, T_NEW, [0], when=(tag == T_NEW) & ~routed)
+
+        st["c_id"] = jnp.where(start, st["c_opn"] + 1, st["c_id"])
+        st["c_op"] = jnp.where(
+            start, jnp.where(ctx.bernoulli(0.5), OP_PUT, OP_GET), st["c_op"])
+        st["c_key"] = jnp.where(start, ctx.randint(0, self.K - 1),
+                                st["c_key"])
+        st["c_val"] = jnp.where(start, ctx.node * 4096 + st["c_opn"],
+                                st["c_val"])
+        st["c_wait"] = jnp.where(start, 1, st["c_wait"])
+        oidx = jnp.clip(st["c_opn"], 0, self.O - 1)
+        for h, v in (("h_op", st["c_op"]), ("h_key", st["c_key"]),
+                     ("h_val", st["c_val"]), ("h_inv", ctx.now)):
+            st[h] = st[h].at[oidx].set(jnp.where(start, v, st[h][oidx]))
+
+        # timeout: refresh the config (the shard may have moved) and retry
+        retry = ((tag == T_RETRY) & (st["c_wait"] == 1)
+                 & (payload[0] == st["c_id"]))
+        self._refresh(ctx, retry)
+        self._issue(ctx, st, start | retry)
+        ctx.state = st
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        # config updates
+        is_cfgr = tag == CFGR
+        newer = is_cfgr & (payload[0] > st["cl_cfg"])
+        st["cl_cfg"] = jnp.where(newer, payload[0], st["cl_cfg"])
+        st["cl_asn"] = jnp.where(newer, payload[1], st["cl_asn"])
+
+        hit = ((tag == CRSP) & (st["c_wait"] == 1)
+               & (payload[0] == st["c_id"]))
+        oidx = jnp.clip(st["c_opn"], 0, self.O - 1)
+        st["h_resp"] = st["h_resp"].at[oidx].set(
+            jnp.where(hit, ctx.now, st["h_resp"][oidx]))
+        st["h_val"] = st["h_val"].at[oidx].set(
+            jnp.where(hit & (st["h_op"][oidx] == OP_GET), payload[1],
+                      st["h_val"][oidx]))
+        st["c_opn"] = st["c_opn"] + hit
+        st["c_wait"] = jnp.where(hit, 0, st["c_wait"])
+        ctx.set_timer(self.think, T_NEW, [0], when=hit)
+
+        # wrong group: our config is stale — refresh now; the armed retry
+        # timer re-issues with the updated routing
+        wrong = ((tag == CWRONG) & (st["c_wait"] == 1)
+                 & (payload[0] == st["c_id"]))
+        self._refresh(ctx, wrong)
+        ctx.state = st
+
+
+def compose_invariants(*invs):
+    """OR a set of per-group invariants into one (bad, code) check."""
+    def inv(state):
+        bads, codes = [], []
+        for f in invs:
+            b, c = f(state)
+            bads.append(b)
+            codes.append(c)
+        bad = jnp.stack(bads).any()
+        code = jnp.asarray(0, jnp.int32)
+        for b, c in zip(reversed(bads), reversed(codes)):
+            code = jnp.where(b, c, code)
+        return bad, code
+    return inv
+
+
+def all_clients_done(clients_base: int, n_ops: int):
+    def check(state):
+        return (state.node_state["c_opn"][clients_base:] >= n_ops).all()
+    return check
+
+
+def make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2, n_keys=8,
+                       n_shards=4, n_ops=6, max_cfg=4, log_capacity=64,
+                       scenario=None, cfg=None, **kw):
+    """Assemble the full sharded-KV cluster runtime."""
+    from ..core.types import NetConfig, SimConfig, sec
+    from ..runtime.runtime import Runtime
+    n = rc + n_groups * rg + n_clients
+    if cfg is None:
+        cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=12,
+                        time_limit=sec(30),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(10)))
+    assert cfg.payload_words >= 2 + n_keys // n_shards + 2 * n_clients, \
+        "PULLR must fit one payload (chunk bigger shards over net/streaming)"
+    common = dict(n_keys=n_keys, n_shards=n_shards, n_clients=n_clients,
+                  max_cfg=max_cfg)
+    progs = [CfgRaft(n, log_capacity, rc=rc, n_groups=n_groups,
+                     n_shards=n_shards, max_cfg=max_cfg, **kw)]
+    for g in range(n_groups):
+        progs.append(ShardServer(n, log_capacity, gid=g, rc=rc, rg=rg,
+                                 n_groups=n_groups, **common, **kw))
+    progs.append(ShardClient(rc=rc, rg=rg, n_groups=n_groups,
+                             n_shards=n_shards, n_keys=n_keys, n_ops=n_ops,
+                             max_cfg=max_cfg))
+    node_prog = np.asarray([0] * rc
+                           + sum(([1 + g] * rg for g in range(n_groups)), [])
+                           + [1 + n_groups] * n_clients, np.int32)
+    masks = [np.arange(n) < rc]
+    for g in range(n_groups):
+        base = rc + g * rg
+        masks.append((np.arange(n) >= base) & (np.arange(n) < base + rg))
+    inv = compose_invariants(
+        *[R.raft_invariant(n, log_capacity, FIELDS, m) for m in masks])
+    clients_base = rc + n_groups * rg
+    return Runtime(cfg, progs,
+                   shard_state_spec(n, log_capacity, n_groups=n_groups,
+                                    n_ops=n_ops, **common),
+                   node_prog=node_prog, scenario=scenario, invariant=inv,
+                   persist=shard_persist_spec(),
+                   halt_when=all_clients_done(clients_base, n_ops))
+
+
+def extract_histories(state, clients_base: int, n_clients: int):
+    """Per-trajectory client histories (same shape as raft_kv's)."""
+    ns = state.node_state
+    h = {k: np.asarray(ns[k]) for k in
+         ("h_op", "h_key", "h_val", "h_inv", "h_resp")}
+    out = []
+    for b in range(h["h_op"].shape[0]):
+        sl = slice(clients_base, clients_base + n_clients)
+        started = h["h_inv"][b, sl] >= 0
+        out.append(dict(
+            op=h["h_op"][b, sl][started], key=h["h_key"][b, sl][started],
+            val=h["h_val"][b, sl][started], inv=h["h_inv"][b, sl][started],
+            resp=h["h_resp"][b, sl][started]))
+    return out

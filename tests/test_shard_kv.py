@@ -1,0 +1,124 @@
+"""ShardKV: multi-group raft + reconfiguration + shard migration.
+
+The model is madsim_tpu/models/shard_kv.py (MadRaft shardkv-lab analog).
+These tests are the lab's assertions re-shaped for batched fuzzing:
+configs actually advance and move shards, clients finish against live
+migrations, histories stay linearizable under chaos, and the safety
+invariants hold per group.
+
+All batch tests share ONE runtime shape (same n_ops/max_cfg/batch/config
+statics) so the step program compiles once; chaos differences ride the
+dynamic knobs (scenario tables, loss via net_override).
+"""
+
+import numpy as np
+import pytest
+
+from madsim_tpu import NetConfig, Scenario, SimConfig, ms, sec
+from madsim_tpu.harness.simtest import run_seeds
+from madsim_tpu.models.shard_kv import (
+    extract_histories, grp_of, make_shard_runtime)
+from madsim_tpu.native import check_kv_history
+
+RC, RG, G, NC = 3, 3, 2, 2
+CLIENTS_BASE = RC + G * RG
+N = CLIENTS_BASE + NC
+N_OPS, MAX_CFG, B = 5, 4, 12
+
+
+def _runtime(scenario=None):
+    cfg = SimConfig(n_nodes=N, event_capacity=384, payload_words=12,
+                    time_limit=sec(60),
+                    net=NetConfig(send_latency_min=ms(1),
+                                  send_latency_max=ms(10)))
+    return make_shard_runtime(n_groups=G, rg=RG, rc=RC, n_clients=NC,
+                              n_ops=N_OPS, max_cfg=MAX_CFG,
+                              scenario=scenario, cfg=cfg)
+
+
+def _final_cfgs(state):
+    """Controller-majority view of the final config number, per lane."""
+    return np.asarray(state.node_state["cfg_n"])[:, :RC].max(axis=1)
+
+
+class TestShardKv:
+    def test_migration_completes_and_linearizable(self):
+        state = run_seeds(_runtime(), np.arange(B), max_steps=60_000)
+        # every lane finished its client workload
+        done = np.asarray(state.node_state["c_opn"])[:, CLIENTS_BASE:]
+        assert (done >= N_OPS).all()
+        # configs advanced past the initial assignment in most lanes —
+        # i.e. shard moves actually happened while clients ran
+        cfgs = _final_cfgs(state)
+        assert (cfgs >= 1).all()
+        assert (cfgs >= 2).mean() > 0.5, cfgs
+        for h in extract_histories(state, CLIENTS_BASE, NC):
+            assert len(h["op"]) > 0
+            assert check_kv_history(h)
+
+    def test_chaos_histories_linearizable(self):
+        # kills/restarts across ALL raft nodes (controller included),
+        # a partition, and packet loss — during live shard migration
+        servers = range(CLIENTS_BASE)
+        sc = Scenario()
+        for t in range(3):
+            sc.at(ms(1200 + 1500 * t)).kill_random(among=servers)
+            sc.at(ms(1900 + 1500 * t)).restart_random(among=servers)
+        sc.at(sec(2)).partition([0, RC, RC + 1])
+        sc.at(sec(3)).heal()
+        state = run_seeds(_runtime(sc), np.arange(B), max_steps=120_000,
+                          net_override=NetConfig(packet_loss_rate=0.05,
+                                                 send_latency_min=ms(1),
+                                                 send_latency_max=ms(10)))
+        hists = extract_histories(state, CLIENTS_BASE, NC)
+        assert sum(len(h["op"]) for h in hists) > 0
+        ok = 0
+        for h in hists:
+            assert check_kv_history(h)
+            ok += int((np.asarray(h["resp"]) >= 0).sum())
+        assert ok > 0, "no operation completed under chaos"
+
+    def test_sessions_migrate_with_shards(self):
+        # with migrations on and retries forced by loss, exactly-once must
+        # hold ACROSS group handoffs: duplicate client calls answered by a
+        # different group than the one that executed them. Linearizability
+        # of the histories is exactly that property (a re-executed PUT
+        # would surface as a second write of the same unique value; a GET
+        # replayed against a stale shard copy surfaces as a stale read).
+        state = run_seeds(_runtime(), np.arange(B), max_steps=120_000,
+                          net_override=NetConfig(packet_loss_rate=0.15,
+                                                 send_latency_min=ms(1),
+                                                 send_latency_max=ms(10)))
+        moved = 0
+        for h in extract_histories(state, CLIENTS_BASE, NC):
+            assert check_kv_history(h)
+            moved += len(h["op"])
+        assert moved > 0
+        cfgs = _final_cfgs(state)
+        assert (cfgs >= 2).any(), "no lane saw a migration"
+
+    def test_determinism_replay(self):
+        assert _runtime().check_determinism(11, 20_000)
+
+    def test_wrong_group_rejected_until_ready(self):
+        # the packing helper the gates are built on
+        asn = (1 << 0) | (0 << 3) | (1 << 6) | (1 << 9)
+        assert int(grp_of(asn, 0)) == 1
+        assert int(grp_of(asn, 1)) == 0
+        assert int(grp_of(asn, 2)) == 1
+        assert int(grp_of(asn, 3)) == 1
+        # the serving gate itself: owned-but-not-READY must refuse (this is
+        # the edge that prevents dual-serving during migration), as must
+        # not-owned and config-0
+        import jax.numpy as jnp
+        from madsim_tpu.models.shard_kv import ShardServer
+        srv = ShardServer(N, 64, gid=1, rc=RC, rg=RG, n_groups=G,
+                          n_keys=8, n_shards=4, n_clients=NC,
+                          max_cfg=MAX_CFG)
+        st = dict(my_cfg=jnp.asarray(2), my_asn=jnp.asarray(asn),
+                  ready=jnp.asarray(0b0101))
+        assert bool(srv._owns(st, jnp.asarray(0)))          # owned + ready
+        assert not bool(srv._owns(st, jnp.asarray(1)))      # other group's
+        assert not bool(srv._owns(st, jnp.asarray(3)))      # owned, ~ready
+        st0 = dict(st, my_cfg=jnp.asarray(0))
+        assert not bool(srv._owns(st0, jnp.asarray(0)))     # no config yet
