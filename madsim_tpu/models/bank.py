@@ -230,7 +230,7 @@ def make_bank_runtime(n_raft=5, n_clients=3, n_accounts=6, n_ops=12,
     from ..runtime.runtime import Runtime
     n = n_raft + n_clients
     if cfg is None:
-        cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=13,
+        cfg = SimConfig(n_nodes=n, event_capacity=96, payload_words=13,
                         time_limit=sec(20))
     assert cfg.payload_words >= 6 + len(BANK_FIELDS)
     assert log_capacity >= n_clients * n_ops + 4

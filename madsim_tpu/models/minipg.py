@@ -462,7 +462,7 @@ def make_minipg_runtime(n_clients=2, n_txns=4, scenario=None, cfg=None,
     n = 1 + n_clients
     n_keys = 2 * n_clients
     if cfg is None:
-        cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=8,
+        cfg = SimConfig(n_nodes=n, event_capacity=64, payload_words=8,
                         time_limit=sec(10),
                         net=NetConfig(send_latency_min=ms(1),
                                       send_latency_max=ms(8)))

@@ -346,7 +346,7 @@ def make_kv_runtime(n_raft=5, n_clients=3, n_keys=4, n_ops=12,
     from ..runtime.runtime import Runtime
     n = n_raft + n_clients
     if cfg is None:
-        cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=12,
+        cfg = SimConfig(n_nodes=n, event_capacity=128, payload_words=12,
                         time_limit=sec(20))
     assert cfg.payload_words >= 6 + len(KV_FIELDS)
     if not raft_kw.get("compact_threshold"):

@@ -569,7 +569,7 @@ def make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2, n_keys=8,
     from ..runtime.runtime import Runtime
     n = rc + n_groups * rg + n_clients
     if cfg is None:
-        cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=12,
+        cfg = SimConfig(n_nodes=n, event_capacity=160, payload_words=12,
                         time_limit=sec(30),
                         net=NetConfig(send_latency_min=ms(1),
                                       send_latency_max=ms(10)))

@@ -324,7 +324,7 @@ def make_stream_echo_runtime(mode: str, n_clients: int = 2, n_items: int = 6,
     from ..runtime.runtime import Runtime
     n = 1 + n_clients
     if cfg is None:
-        cfg = SimConfig(n_nodes=n, event_capacity=256, payload_words=8,
+        cfg = SimConfig(n_nodes=n, event_capacity=64, payload_words=8,
                         time_limit=sec(10),
                         net=NetConfig(send_latency_min=ms(1),
                                       send_latency_max=ms(8)))

@@ -25,7 +25,7 @@ class TestBank:
         assert (seen == TOTAL).all()
 
     def test_chaos_conserves(self):
-        cfg = SimConfig(n_nodes=8, event_capacity=384, payload_words=13,
+        cfg = SimConfig(n_nodes=8, event_capacity=96, payload_words=13,
                         time_limit=sec(8),
                         net=NetConfig(packet_loss_rate=0.05))
         sc = Scenario()
@@ -62,7 +62,7 @@ class TestBank:
         from madsim_tpu import Runtime
         n_raft, n_clients = 3, 2
         n = n_raft + n_clients
-        cfg = SimConfig(n_nodes=n, event_capacity=384, payload_words=13,
+        cfg = SimConfig(n_nodes=n, event_capacity=96, payload_words=13,
                         time_limit=sec(20))
         rt = Runtime(cfg, [Leaky(n, 6, 100, 32, n_peers=n_raft),
                            BankClient(n_raft, 6, 6)],

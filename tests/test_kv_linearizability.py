@@ -77,7 +77,7 @@ class TestKvFuzz:
     def test_chaos_histories_linearizable(self):
         # kills/partitions/loss: ops may time out (pending), leaders churn,
         # but every observed response must stay linearizable
-        cfg = SimConfig(n_nodes=8, event_capacity=384, payload_words=12,
+        cfg = SimConfig(n_nodes=8, event_capacity=128, payload_words=12,
                         time_limit=sec(8),
                         net=NetConfig(packet_loss_rate=0.05))
         rt = make_kv_runtime(n_raft=5, n_clients=3, n_keys=3, n_ops=8,
@@ -120,7 +120,7 @@ class TestKvFuzz:
     def test_batch_vs_single_replay_equivalence(self):
         # the replay-by-seed contract on the FULL stack: seed i inside a
         # chaos batch reaches bit-identical state to seed i run alone
-        cfg = SimConfig(n_nodes=8, event_capacity=384, payload_words=12,
+        cfg = SimConfig(n_nodes=8, event_capacity=128, payload_words=12,
                         time_limit=sec(4),
                         net=NetConfig(packet_loss_rate=0.05))
         rt = make_kv_runtime(n_raft=5, n_clients=3, n_keys=3, n_ops=6,
@@ -132,7 +132,7 @@ class TestKvFuzz:
 
     def test_checkpoint_mid_chaos_resumes_identically(self):
         from madsim_tpu.runtime import checkpoint
-        cfg = SimConfig(n_nodes=8, event_capacity=384, payload_words=12,
+        cfg = SimConfig(n_nodes=8, event_capacity=128, payload_words=12,
                         time_limit=sec(4),
                         net=NetConfig(packet_loss_rate=0.05))
         rt = make_kv_runtime(n_raft=5, n_clients=3, n_keys=3, n_ops=6,

@@ -19,7 +19,7 @@ L = 12  # total committed entries (30 ops + no-ops) far exceed the window
 
 
 def _cfg(time_limit=sec(12), loss=0.0):
-    return SimConfig(n_nodes=N_RAFT + N_CLIENTS, event_capacity=384,
+    return SimConfig(n_nodes=N_RAFT + N_CLIENTS, event_capacity=128,
                      payload_words=12, time_limit=time_limit,
                      net=NetConfig(packet_loss_rate=loss,
                                    send_latency_min=ms(1),

@@ -27,7 +27,7 @@ N_OPS, MAX_CFG, B = 5, 4, 12
 
 
 def _runtime(scenario=None):
-    cfg = SimConfig(n_nodes=N, event_capacity=384, payload_words=12,
+    cfg = SimConfig(n_nodes=N, event_capacity=160, payload_words=12,
                     time_limit=sec(60),
                     net=NetConfig(send_latency_min=ms(1),
                                   send_latency_max=ms(10)))

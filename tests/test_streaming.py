@@ -19,7 +19,7 @@ SEEDS = np.arange(8)
 
 
 def _cfg(loss=0.0, time_limit=sec(8)):
-    return SimConfig(n_nodes=3, event_capacity=256, payload_words=8,
+    return SimConfig(n_nodes=3, event_capacity=64, payload_words=8,
                      time_limit=time_limit,
                      net=NetConfig(packet_loss_rate=loss,
                                    send_latency_min=ms(1),
