@@ -47,9 +47,9 @@ def _make_runtime():
                              cfg=cfg)
 
 
-def _events_per_sec(batch: int, steps: int, warm: int) -> float:
+def _events_per_sec(batch: int, steps: int, warm: int, make=None) -> float:
     import jax
-    rt = _make_runtime()
+    rt = (make or _make_runtime)()
     state = rt.init_batch(np.arange(batch))
     runner = rt._run_chunk[False]
     # warmup with the SAME static chunk length as the timed region, so the
@@ -182,6 +182,41 @@ print(f"RESULT pid={pid} wall={dt:.4f} halted_any={halted_any}", flush=True)
 """
 
 
+def _shardkv_mode():
+    """--shardkv: batched throughput of the multi-group ShardKV model
+    (config service + 2 kv raft groups + clients, live shard migration)
+    on the default platform. A second per-workload datapoint beyond the
+    flagship Raft chaos bench — heavier per event (4 programs, 11 nodes,
+    migration machinery), so absolute seed-events/s is expected below the
+    flagship's."""
+    from madsim_tpu.core.types import SimConfig, NetConfig, ms, sec
+    from madsim_tpu.models.shard_kv import make_shard_runtime
+
+    B, steps = 1024, 512
+
+    def make():
+        # n_ops sized so client work outlasts warm+timed chunks (one
+        # event per step per lane; each op costs ~10 events), log sized
+        # to hold it all, virtual time uncapped for the bench horizon —
+        # the shared timing helper asserts no crash/overflow/idling
+        cfg = SimConfig(n_nodes=11, event_capacity=160, payload_words=12,
+                        time_limit=sec(600),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(10)))
+        return make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2,
+                                  n_ops=64, max_cfg=8, log_capacity=192,
+                                  cfg=cfg)
+
+    eps = _events_per_sec(B, steps, WARM, make=make)
+    print(json.dumps({
+        "metric": "shardkv_migration_seed_events_per_sec",
+        "value": round(eps, 1),
+        "unit": "seed*events/s (2 kv groups + config group, live shard "
+                "migration)",
+        "batch": B,
+    }))
+
+
 def _multihost_mode():
     """--multihost: run the flagship workload sharded over TWO real
     jax.distributed processes (loopback coordinator, CPU devices) and
@@ -266,6 +301,9 @@ def main():
         return
     if "--sweep" in sys.argv:
         _sweep_mode()
+        return
+    if "--shardkv" in sys.argv:
+        _shardkv_mode()
         return
     if "--scaling" in sys.argv:
         _scaling_mode()

@@ -12,6 +12,9 @@ for i in $(seq 1 200); do
     echo "$(date -u +%H:%M:%S) running tuning sweep" >> tpu_watch.log
     python bench.py --sweep > BENCH_tpu_sweep.json 2>> tpu_watch.log
     echo "$(date -u +%H:%M:%S) sweep done rc=$?" >> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) running shardkv bench" >> tpu_watch.log
+    python bench.py --shardkv > BENCH_tpu_shardkv.json 2>> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) shardkv done rc=$?" >> tpu_watch.log
     exit 0
   fi
   echo "$(date -u +%H:%M:%S) probe $i: tunnel dead" >> tpu_watch.log
