@@ -119,6 +119,22 @@ class Ctx:
             payload=as_payload(payload, self.cfg.payload_words),
         ))
 
+    def defer(self, tag, payload=None, *, when=True) -> None:
+        """Continuation idiom: schedule on_timer(tag, payload) at the
+        CURRENT deadline (a zero-delay timer).
+
+        A madsim node's tasks interleave at every await point under the
+        random scheduler (task.rs:128-143); here a handler is atomic, so a
+        long multi-phase handler under-explores schedules. Splitting its
+        phases with `defer` re-opens the interleaving: the continuation
+        lands in the event table at the same virtual time as anything else
+        due now, and the same-deadline random tie-break (mpsc.rs:75
+        semantics) orders it against other nodes' events — the explicit
+        state-machine form of yield_now/await. See DESIGN.md §3 and the
+        coverage measurement in tests/test_core.py.
+        """
+        self.set_timer(0, tag, payload, when=when)
+
     def crash_if(self, cond, code: int) -> None:
         """Assert: if cond, the trajectory crashes with user code > 0 —
         the panic-in-task analog; the harness reports the seed."""

@@ -38,6 +38,15 @@ class SimState:
     crash_node: jax.Array   # int32 — node implicated, -1 if n/a
     oops: jax.Array         # int32 bitmask — capacity overflows
     steps: jax.Array        # int32 — events dispatched so far
+    sched_hash: jax.Array   # uint32 — running hash of the dispatch sequence
+                            # (kind/node/src/tag of every event, in order).
+                            # Two trajectories with different interleavings
+                            # get different hashes even when they converge
+                            # to the same terminal state — the
+                            # schedule-coverage metric proper, vs the
+                            # terminal-fingerprint proxy (task.rs:572-596
+                            # asserts N seeds -> N schedules; this is the
+                            # batched measurement of that property)
     tlimit: jax.Array       # int32 ticks — virtual-time limit; DYNAMIC (like
                             # loss/latency) so set_time_limit / the
                             # MADSIM_TEST_TIME_LIMIT env knob need no recompile
@@ -89,6 +98,7 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         crash_node=jnp.asarray(-1, i32),
         oops=jnp.asarray(0, i32),
         steps=jnp.asarray(0, i32),
+        sched_hash=jnp.asarray(2166136261, jnp.uint32),   # FNV offset basis
         tlimit=jnp.asarray(cfg.time_limit, i32),
         t_deadline=jnp.full((C,), T.T_INF, i32),
         t_kind=jnp.zeros((C,), i32),

@@ -130,6 +130,18 @@ def make_step(
         ev_tag = sel.take1(s.t_tag, idx)
         ev_payload = sel.take_row(s.t_payload, idx)
 
+        # schedule-coverage hash: fold the dispatched event's identity into
+        # a running FNV-style mix. Pure VPU arithmetic, consumes no
+        # randomness, so it cannot perturb replay; distinct interleavings
+        # yield distinct hashes even when terminal states coincide.
+        u32 = jnp.uint32
+        ev_mix = (ev_kind.astype(u32) * u32(0x9E3779B1)
+                  ^ ev_node.astype(u32) * u32(0x85EBCA77)
+                  ^ ev_src.astype(u32) * u32(0xC2B2AE3D)
+                  ^ ev_tag.astype(u32) * u32(0x27D4EB2F))
+        sched_hash = jnp.where(valid, (s.sched_hash ^ ev_mix) * u32(16777619),
+                               s.sched_hash)
+
         # pop the slot; clock never runs backward (resumed nodes' past-due
         # events fire "now", the park/unpark analog of task.rs:134-137)
         now = jnp.where(valid, jnp.maximum(s.now, dmin), s.now)
@@ -139,6 +151,7 @@ def make_step(
         s = s.replace(
             key=key,
             now=now,
+            sched_hash=sched_hash,
             t_kind=sel.put_row(s.t_kind, idx,
                                jnp.asarray(T.EV_FREE, jnp.int32), valid),
             t_deadline=sel.put_row(s.t_deadline, idx,
@@ -371,9 +384,10 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     # resolve NODE_RANDOM targets (fuzzing): each op draws from the pool of
     # nodes it can meaningfully act on — kill/pause/clog a random alive node,
     # restart a random dead one, resume a random paused one, unclog a random
-    # clogged one. payload[0] optionally restricts candidates to a bitmask
-    # (31 nodes/word, word 0 only) so e.g. chaos kills target servers but
-    # not client/harness nodes.
+    # clogged one. A nonzero payload restricts candidates to a bitmask
+    # (31 nodes/word across ALL payload words, same packing as
+    # OP_PARTITION) so e.g. chaos kills target servers but not
+    # client/harness nodes, for any N <= 31 * payload_words.
     want_alive = (op == T.OP_KILL) | (op == T.OP_PAUSE) | (op == T.OP_CLOG_NODE)
     pool = jnp.where(want_alive, s.alive,
                      jnp.where(op == T.OP_RESTART, ~s.alive,
@@ -382,8 +396,9 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
                                                    s.clog_node,
                                                    jnp.ones((N,), bool)))))
     ids = jnp.arange(N, dtype=jnp.int32)
-    in_pool = ((payload[0] >> jnp.clip(ids, 0, 30)) & 1) == 1
-    pool = pool & jnp.where(payload[0] != 0, in_pool & (ids < 31),
+    pool_words = sel.take1(payload, ids // 31)    # one-hot: vector-index
+    in_pool = ((pool_words >> (ids % 31)) & 1) == 1     # gathers serialize
+    pool = pool & jnp.where((payload != 0).any(), in_pool,
                             jnp.ones((N,), bool))
     rnd, rnd_ok = sel.masked_choice(k_t, pool)
     is_random = node == T.NODE_RANDOM

@@ -25,23 +25,45 @@ def find_divergence(rt, seed: int, max_steps: int, probe: int = 64):
     vfp = jax.jit(jax.vmap(fingerprint))
     runner = rt._run_chunk[True]
 
+    def keep(s):
+        # runner donates its input buffers (donate_argnums=0); snapshot
+        # any state we may need to re-run from
+        return jax.tree.map(lambda a: a.copy(), s)
+
     s1 = rt.init_single(seed)
     s2 = rt.init_single(seed)
     step = 0
     while step < max_steps:
+        c1, c2 = keep(s1), keep(s2)    # window-start snapshots
         n1, e1 = runner(s1, probe)
         n2, e2 = runner(s2, probe)
         if np.asarray(vfp(n1))[0] != np.asarray(vfp(n2))[0]:
-            # bisect inside this probe window, one step at a time (probe is
-            # small; recompiling a length-1 chunk once is fine)
-            one = rt._run_chunk[True]
-            for j in range(probe):
-                s1, e1 = one(s1, 1)
-                s2, e2 = one(s2, 1)
-                if np.asarray(vfp(s1))[0] != np.asarray(vfp(s2))[0]:
-                    ev = {k: np.asarray(v)[0, 0] for k, v in e1.items()}
-                    return dict(step=step + j, event=ev)
-            return dict(step=step + probe - 1, event=None)
+            # true binary search inside the divergent window: invariant is
+            # (a1, a2) identical after `lo` window steps, divergence within
+            # the next hi-lo. Halves are powers of two (use a power-of-two
+            # probe), so at most log2(probe) distinct chunk lengths ever
+            # compile (each cached per Runtime) instead of a length-1
+            # recompile + linear walk.
+            a1, a2 = c1, c2            # identical states at `step`
+            lo, hi = 0, probe
+            while hi - lo > 1:
+                half = (hi - lo) // 2
+                m1, _ = runner(keep(a1), half)
+                m2, _ = runner(keep(a2), half)
+                if np.asarray(vfp(m1))[0] != np.asarray(vfp(m2))[0]:
+                    hi = lo + half     # diverges in the first half
+                else:
+                    a1, a2, lo = m1, m2, lo + half
+            # confirm the localization: the divergence we're hunting is
+            # nondeterminism, which may not reproduce on re-execution from
+            # the snapshot — in that case report the window with
+            # event=None ("could not pin it") rather than a false step
+            f1, e1 = runner(a1, 1)
+            f2, _ = runner(a2, 1)
+            if np.asarray(vfp(f1))[0] == np.asarray(vfp(f2))[0]:
+                return dict(step=step + lo, event=None)
+            ev = {k: np.asarray(v)[0, 0] for k, v in e1.items()}
+            return dict(step=step + lo, event=ev)
         s1, s2 = n1, n2
         step += probe
         if bool(np.asarray(n1.halted).all()):

@@ -342,13 +342,17 @@ class ShardServer(R.Raft):
             # OP_INS_* — install a pulled shard image, fenced by (s, num)
             ins_s = jnp.clip(st["log_key"][slot], 0, S - 1)   # SES/DONE key
             not_ready = (((st["ready"] >> ins_s) & 1) == 0)
+            # ownership fence mirrors is_done: a stale OP_INS_* must not
+            # touch cells for a shard this group no longer owns
             is_ikv = (can & (op == OP_INS_KV) & (rtag == st["my_cfg"])
-                      & (((st["ready"] >> s_of_key) & 1) == 0))
+                      & (((st["ready"] >> s_of_key) & 1) == 0)
+                      & (grp_of(st["my_asn"], s_of_key) == self.gid))
             st["kv"] = st["kv"].at[key].set(
                 jnp.where(is_ikv, val, st["kv"][key]))
             is_ses = (can & (op == OP_INS_SES)
                       & ((rtag & ((1 << MAXCFG_BITS) - 1)) == st["my_cfg"])
-                      & not_ready)
+                      & not_ready
+                      & (grp_of(st["my_asn"], ins_s) == self.gid))
             st["sess_rtag"] = st["sess_rtag"].at[cid, ins_s].set(
                 jnp.where(is_ses, rtag >> MAXCFG_BITS,
                           st["sess_rtag"][cid, ins_s]))
@@ -403,8 +407,12 @@ class ShardServer(R.Raft):
         # the session table — counting it as pending would drop the
         # client's retries forever; re-appending is the correct replay.
         unapplied = ks >= (st["applied"] - st["snap_len"])
+        # op filter: an unapplied OP_INS_SES for this client carries a
+        # migrated session tag in log_rtag that can collide with a small
+        # call id and transiently suppress a legitimate append
+        is_cli_op = (st["log_op"] == OP_PUT) | (st["log_op"] == OP_GET)
         pending = ((st["log_rtag"] == rtag) & (st["log_client"] == src)
-                   & (ks < live) & unapplied).any()
+                   & is_cli_op & (ks < live) & unapplied).any()
         self._append(ctx, st,
                      is_cmd & leader & owns & ~sess_hit & ~stale & ~pending,
                      dict(op=cop, key=ckey, val=cval, client=src, rtag=rtag))

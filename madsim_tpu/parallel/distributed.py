@@ -66,6 +66,42 @@ def host_seed_slice(total_seeds: int, base_seed: int = 0) -> np.ndarray:
                      dtype=np.uint32)
 
 
+def run_compacting_sharded(rt, seeds: np.ndarray, max_steps: int,
+                           chunk: int = 512, compact_when: float = 0.5,
+                           min_batch: int = 256):
+    """Divergent-trajectory compaction at multi-process scale (BASELINE
+    config 4): each process runs `Runtime.run_compacting` on ITS
+    host-addressable slice of the sweep — early-halting lanes are stashed
+    and survivors re-packed entirely within the host, so no cross-host
+    traffic happens during the run — then the per-host full-slice final
+    states are assembled into one global sharded array for cross-process
+    reductions (first-crash argmin, stats), the only collective step.
+
+    `seeds` is this process's LOCAL slice (from `host_seed_slice`).
+    Returns the global sharded state in global lane order.
+
+    This is the documented per-host-compaction path of
+    `Runtime.run_compacting` (runtime/runtime.py), which itself refuses
+    non-addressable batches: compaction re-packs lanes through host numpy
+    and is inherently a local operation. Reference analog: each `cargo
+    test` process finishes its own seeds at its own pace; only results
+    are aggregated (SURVEY.md §5 scale-out lever).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    local = rt.init_batch(seeds)
+    final = rt.run_compacting(local, max_steps, chunk=chunk,
+                              compact_when=compact_when,
+                              min_batch=min_batch)
+    mesh = global_seed_mesh()
+    if jax.process_count() == 1:
+        return shard_batch(final, mesh)
+    sharding = NamedSharding(mesh, P("seeds"))
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(
+            sharding, np.asarray(a)), final)
+
+
 def shard_global(rt, seeds: np.ndarray):
     """Build this host's LOCAL batch (its host_seed_slice) and assemble the
     global sharded state. Multi-process JAX requires assembling global

@@ -51,5 +51,12 @@ def summarize(rt, state, seeds=None) -> dict:
                      if rt.cfg.collect_stats else None),
         # schedule-space coverage proxy: distinct terminal states
         distinct_outcomes=int(len(np.unique(fps))),
+        # schedule-space coverage proper: distinct dispatch ORDERS — the
+        # batched form of task.rs:572-596's "N seeds -> N schedules".
+        # Always >= distinct_outcomes in information content: trajectories
+        # that interleave differently but converge to one terminal state
+        # still count as distinct explored schedules.
+        distinct_schedules=int(
+            len(np.unique(np.asarray(state.sched_hash)))),
         oops=int((np.asarray(state.oops) != 0).sum()),
     )

@@ -6,21 +6,36 @@ zero changes (madsim/src/lib.rs:15-24 selects `mod sim` vs `mod std`;
 std/net/tcp.rs is the real Endpoint). The analog here: the SAME `Program`
 subclasses (state machines over jnp ops, which execute eagerly on concrete
 arrays) run either vectorized under jit (runtime/runtime.py) or against real
-wall-clock time and real UDP sockets via this asyncio runtime. Protocol code
+wall-clock time and real sockets via this asyncio runtime. Protocol code
 is written once; the world is chosen at Runtime-construction time.
+
+Transports are pluggable (real/transport.py — the std/net/mod.rs seam):
+"udp", "tcp", and the in-memory "local" backend ship; new ones register
+without editing this file.
 
 Wire format: little-endian int32s [tag, src_node, payload[P]] — the
 tag-matched datagram model of the reference's real TCP backend
 (std/net/tcp.rs frames [len][tag][payload]), minus streams (UDP fits the
 sim's message semantics; loss/reorder are real-network properties here).
+
+Durability: with `data_dir` set, persist-marked state leaves are spilled
+to disk after every event (write-fsync-rename, so a kill -9 of the whole
+OS process can never observe a torn file) and reloaded on node start —
+the std/fs.rs twin (fs.rs:1-60 backs sim files with real ones). Because
+fs.py keeps page-cache and disk-view as SEPARATE leaves and only sync_all
+copies cache->disk, spilling the persist leaves (the disk views) after
+each event makes on-disk state exactly "stable storage as of the last
+sync": unsynced writes die with the process, synced ones survive it.
 """
 
 from __future__ import annotations
 
-import asyncio
+import os
 import struct
 import time
 from typing import Any, Sequence
+
+import asyncio
 
 import jax.numpy as jnp
 import numpy as np
@@ -28,14 +43,7 @@ import numpy as np
 from ..core import prng
 from ..core import types as T
 from ..core.api import Ctx, Program
-
-
-class _NodeProtocol(asyncio.DatagramProtocol):
-    def __init__(self, rt: "RealRuntime", node: int):
-        self.rt, self.node = rt, node
-
-    def datagram_received(self, data, addr):
-        self.rt._on_datagram(self.node, data)
+from .transport import TRANSPORTS
 
 
 class RealNode:
@@ -45,16 +53,11 @@ class RealNode:
         self.alive = False
         self.paused = False
         self.parked: list = []         # events deferred while paused
-        self.transport = None          # udp transport
-        self.server = None             # tcp server
-        self.conns: dict = {}          # tcp: dst -> StreamWriter
-        self.conn_locks: dict = {}     # tcp: dst -> Lock (one dial at a time)
-        self.tasks: list = []          # tcp reader tasks
         self.timers: list[asyncio.TimerHandle] = []
 
 
 class RealRuntime:
-    """Run programs against real time + UDP on 127.0.0.1.
+    """Run programs against real time + real sockets on 127.0.0.1.
 
     API mirrors the simulator Runtime's supervisor surface
     (kill/restart/pause/resume — runtime/mod.rs:200-256) but every operation
@@ -64,8 +67,11 @@ class RealRuntime:
     def __init__(self, cfg: T.SimConfig, programs: Sequence[Program],
                  state_spec: Any, node_prog=None, base_port: int = 19200,
                  seed: int = 0, transport: str = "udp",
-                 persist: Any = None, loss: float = 0.0):
-        assert transport in ("udp", "tcp")
+                 persist: Any = None, loss: float = 0.0,
+                 data_dir: str | None = None):
+        assert transport in TRANSPORTS, \
+            f"unknown transport {transport!r}; registered: " \
+            f"{sorted(TRANSPORTS)}"
         self.transport = transport
         self.cfg = cfg
         self.programs = list(programs)
@@ -75,8 +81,13 @@ class RealRuntime:
         self.base_port = base_port
         # persist: same pytree-of-bools as the simulator Runtime — leaves
         # marked True survive restart() (the std/fs.rs stable-storage twin:
-        # process memory dies, "disk" doesn't)
+        # process memory dies, "disk" doesn't). With data_dir they also
+        # survive death of this whole OS process.
         self.persist = persist
+        self.data_dir = data_dir
+        if data_dir is not None:
+            assert persist is not None, "data_dir requires a persist spec"
+            os.makedirs(data_dir, exist_ok=True)
         # loss: drop this fraction of outgoing datagrams — loopback is
         # near-lossless, so injected loss is how real-world tests exercise
         # retry paths with real sockets
@@ -84,19 +95,26 @@ class RealRuntime:
         import random as _random
         self._loss_rng = _random.Random(seed)
         self.key = prng.seed_key(seed)
-        self.nodes = [RealNode(i, self._fresh_state())
+        self.nodes = [RealNode(i, self._boot_state(i))
                       for i in range(cfg.n_nodes)]
         self.t0 = time.monotonic()
         self.crashed: list[tuple[int, int]] = []   # (node, code)
         self._halted = asyncio.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
-        self._bg: set = set()          # in-flight tcp send tasks
+        self._net = TRANSPORTS[transport](cfg.n_nodes, base_port,
+                                          self._on_packet)
 
     # ------------------------------------------------------------------
     def _fresh_state(self):
         return {k: jnp.asarray(v) for k, v in self.spec.items()} \
             if isinstance(self.spec, dict) else \
             __import__("jax").tree.map(lambda a: jnp.asarray(a), self.spec)
+
+    def _boot_state(self, i: int):
+        fresh = self._fresh_state()
+        if self.data_dir is None:
+            return fresh
+        return self._load_persist(i, fresh)
 
     def now(self) -> int:
         """Virtual-time API, real clock: ticks (us) since runtime start."""
@@ -106,63 +124,50 @@ class RealRuntime:
         self.key, k = prng.split(self.key)
         return k
 
+    # -- on-disk stable storage (std/fs.rs twin) ------------------------
+    def _disk_path(self, i: int) -> str:
+        return os.path.join(self.data_dir, f"node{i}.npz")
+
+    def _persist_items(self, state):
+        """(key, array) for every persist-marked leaf, stable order."""
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        keep = jax.tree_util.tree_leaves(self.persist)
+        assert len(keep) == len(leaves), "persist spec shape mismatch"
+        return [(f"leaf{ix}", lf) for ix, (lf, k)
+                in enumerate(zip(leaves, keep)) if k], treedef
+
+    def _save_persist(self, i: int):
+        import io
+        items, _ = self._persist_items(self.nodes[i].state)
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in items})
+        tmp = self._disk_path(i) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+            f.flush()
+            os.fsync(f.fileno())      # the sync in sync_all made durable
+        os.replace(tmp, self._disk_path(i))   # atomic: never a torn file
+
+    def _load_persist(self, i: int, fresh):
+        import jax
+        path = self._disk_path(i)
+        if not os.path.exists(path):
+            return fresh
+        with np.load(path) as z:
+            saved = dict(z)
+        leaves, treedef = jax.tree_util.tree_flatten(fresh)
+        keep = jax.tree_util.tree_leaves(self.persist)
+        out = [jnp.asarray(saved[f"leaf{ix}"])
+               if k and f"leaf{ix}" in saved else lf
+               for ix, (lf, k) in enumerate(zip(leaves, keep))]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
     # -- lifecycle (Handle analog) -------------------------------------
     async def start_node(self, i: int):
-        n = self.nodes[i]
-        loop = asyncio.get_running_loop()
-        if self.transport == "udp":
-            n.transport, _ = await loop.create_datagram_endpoint(
-                lambda: _NodeProtocol(self, i),
-                local_addr=("127.0.0.1", self.base_port + i))
-        else:
-            # TCP backend: length-delimited frames over lazily-established
-            # per-peer connections — the shape of the reference's real TCP
-            # Endpoint (std/net/tcp.rs:69-151: connect-on-first-send, a
-            # reader task per connection feeding the mailbox)
-            n.server = await asyncio.start_server(
-                lambda r, w: self._tcp_reader(i, r, w),
-                "127.0.0.1", self.base_port + i)
-        n.alive = True
+        await self._net.start_node(i)
+        self.nodes[i].alive = True
         self._dispatch(i, "init")
-
-    async def _tcp_reader(self, node: int, reader, writer):
-        n = self.nodes[node]
-        task = asyncio.current_task()
-        n.tasks.append(task)
-        try:
-            while True:
-                hdr = await reader.readexactly(4)
-                (ln,) = struct.unpack("<I", hdr)
-                data = await reader.readexactly(ln)
-                if self.nodes[node].alive:
-                    self._on_datagram(node, data)
-        except (asyncio.IncompleteReadError, ConnectionError,
-                asyncio.CancelledError):
-            pass
-        finally:
-            writer.close()
-            if task in n.tasks:        # prune on normal close, not just kill
-                n.tasks.remove(task)
-
-    async def _tcp_send(self, src: int, dst: int, pkt: bytes):
-        n = self.nodes[src]
-        if not n.alive:                # killed after the send was queued
-            return
-        lock = n.conn_locks.setdefault(dst, asyncio.Lock())
-        try:
-            async with lock:           # one dial per peer at a time — no
-                w = n.conns.get(dst)   # duplicate-connection leak on
-                if w is None or w.is_closing():  # broadcast bursts
-                    _, w = await asyncio.open_connection(
-                        "127.0.0.1", self.base_port + dst)
-                    if not n.alive:    # killed while dialing
-                        w.close()
-                        return
-                    n.conns[dst] = w
-            w.write(struct.pack("<I", len(pkt)) + pkt)
-            await w.drain()
-        except (ConnectionError, OSError):
-            n.conns.pop(dst, None)  # peer down: datagram-like drop
 
     def kill(self, i: int):
         n = self.nodes[i]
@@ -172,24 +177,17 @@ class RealRuntime:
         for t in n.timers:
             t.cancel()
         n.timers.clear()
-        if n.transport:
-            n.transport.close()
-            n.transport = None
-        if n.server:
-            n.server.close()
-            n.server = None
-        for w in n.conns.values():
-            w.close()
-        n.conns.clear()
-        for t in n.tasks:
-            t.cancel()
-        n.tasks.clear()
+        self._net.close_node(i)
 
     async def restart(self, i: int):
         self.kill(i)
         old = self.nodes[i].state
         fresh = self._fresh_state()                # process memory is lost
-        if self.persist is not None:               # ...stable storage isn't
+        if self.data_dir is not None:
+            # stable storage IS the disk file — reload it, exactly what a
+            # new process would see after kill -9
+            fresh = self._load_persist(i, fresh)
+        elif self.persist is not None:             # in-process stable store
             import jax
             fresh = jax.tree.map(
                 lambda f, o, keep: o if keep else f, fresh, old,
@@ -208,7 +206,7 @@ class RealRuntime:
             self._dispatch(i, kind, *args)
 
     # -- event plumbing -------------------------------------------------
-    def _on_datagram(self, node: int, data: bytes):
+    def _on_packet(self, node: int, data: bytes):
         P = self.cfg.payload_words
         tag, src, *payload = struct.unpack(f"<ii{P}i", data)
         self._dispatch(node, "message", src, tag,
@@ -237,6 +235,11 @@ class RealRuntime:
     def _apply(self, n: RealNode, ctx: Ctx):
         P = self.cfg.payload_words
         n.state = ctx.state
+        if self.data_dir is not None:
+            # spill stable storage BEFORE effects escape: an ack that
+            # promises durability must not be sent while the synced bytes
+            # exist only in this process's memory
+            self._save_persist(n.id)
         for e in ctx._sends:
             if not bool(e["m"]):
                 continue
@@ -248,15 +251,8 @@ class RealRuntime:
             pkt = struct.pack(f"<ii{P}i", int(e["tag"]), n.id,
                               *np.asarray(e["payload"], np.int32))
             # real send: straight to the peer; latency, loss, and
-            # reordering are whatever the real network does
-            if self.transport == "udp":
-                if n.transport is not None:
-                    n.transport.sendto(pkt,
-                                       ("127.0.0.1", self.base_port + dst))
-            else:
-                task = self._loop.create_task(self._tcp_send(n.id, dst, pkt))
-                self._bg.add(task)
-                task.add_done_callback(self._bg.discard)
+            # reordering are whatever the real backend does
+            self._net.send(n.id, dst, pkt)
         for e in ctx._timers:
             if not bool(e["m"]):
                 continue

@@ -108,16 +108,18 @@ class _At:
 
     @staticmethod
     def _pool(among):
-        """Candidate bitmask for random targets (None = everyone)."""
+        """Candidate bitmask for random targets (None = everyone).
+        Packed 31 nodes/word across payload words (the OP_PARTITION
+        packing), so pools cover any N <= 31 * payload_words."""
         if among is None:
             return ()
         among = list(among)
         assert among, "among=[] would mean 'no restriction'; pass None for that"
-        mask = 0
+        words = [0] * (1 + max(int(n) for n in among) // 31)
         for n in among:
-            assert 0 <= int(n) < 31, "pool restriction supports nodes 0..30"
-            mask |= 1 << int(n)
-        return (mask,)
+            assert int(n) >= 0, "node ids are non-negative"
+            words[int(n) // 31] |= 1 << (int(n) % 31)
+        return tuple(words)
 
     def kill_random(self, among=None):
         """Kill a random alive node — target drawn per-seed at fire time.

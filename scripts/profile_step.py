@@ -36,7 +36,11 @@ def main():
 
     paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
     assert paths, f"no xplane under {tmp}"
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # ships with baked-in TF
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:
+        sys.exit(f"trace written to {tmp} but the op-level summary needs "
+                 f"TensorFlow's xplane protos (optional dep): {e}")
     xspace = xplane_pb2.XSpace()
     with open(paths[0], "rb") as f:
         xspace.ParseFromString(f.read())

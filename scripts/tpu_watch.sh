@@ -1,20 +1,27 @@
 #!/bin/bash
-# Poll the axon TPU tunnel; when it answers, run bench.py on the chip.
+# Poll the axon TPU tunnel; when it answers, run the full bench chain on
+# the chip and COMMIT the artifacts (VERDICT r2 next-1: one revival must
+# capture everything durably).
 # Probe uses a killable child (a wedged tunnel hangs jax.devices forever);
-# the bench run itself gets no timeout (killing mid-compile wedges the
-# device claim — see memory/axon-tpu-quirks).
+# the bench runs get no timeout (killing mid-compile wedges the device
+# claim).
 cd /root/repo
 for i in $(seq 1 200); do
   if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform not in ('cpu','none')" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) tunnel alive, running bench" >> tpu_watch.log
     python bench.py > BENCH_tpu.json 2>> tpu_watch.log
     echo "$(date -u +%H:%M:%S) bench done rc=$?" >> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) running combined --all" >> tpu_watch.log
+    python bench.py --all > BENCH_tpu_all.json 2>> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) --all done rc=$?" >> tpu_watch.log
     echo "$(date -u +%H:%M:%S) running tuning sweep" >> tpu_watch.log
     python bench.py --sweep > BENCH_tpu_sweep.json 2>> tpu_watch.log
     echo "$(date -u +%H:%M:%S) sweep done rc=$?" >> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) running shardkv bench" >> tpu_watch.log
-    python bench.py --shardkv > BENCH_tpu_shardkv.json 2>> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) shardkv done rc=$?" >> tpu_watch.log
+    git add BENCH_tpu.json BENCH_tpu_all.json BENCH_tpu_sweep.json \
+        BENCH_TPU_LAST.json tpu_watch.log 2>> tpu_watch.log
+    git commit -m "Record on-chip bench artifacts (flagship + combined --all + sweep)" \
+        >> tpu_watch.log 2>&1
+    echo "$(date -u +%H:%M:%S) artifacts committed" >> tpu_watch.log
     exit 0
   fi
   echo "$(date -u +%H:%M:%S) probe $i: tunnel dead" >> tpu_watch.log

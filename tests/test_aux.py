@@ -61,6 +61,38 @@ class TestDivergence:
         rt = _rt(target=5)
         assert find_divergence(rt, seed=3, max_steps=2000) is None
 
+    def test_binary_search_localizes_exact_step(self):
+        # red path with a duck-typed runtime whose "replica B" (every odd
+        # runner call — find_divergence alternates A,B strictly) perturbs
+        # state while executing step K: the bisection must name exactly
+        # step K and return its event, never touching donated buffers
+        import jax.numpy as jnp
+
+        K = 37
+        calls = {"n": 0}
+
+        def runner(state, chunk):
+            is_b = calls["n"] % 2 == 1
+            calls["n"] += 1
+            step = int(state["step"][0])
+            x = state["x"]
+            if is_b and step <= K < step + chunk:
+                x = x + 1
+            ev = dict(step=jnp.arange(step, step + chunk,
+                                      dtype=jnp.int32)[:, None])
+            return dict(x=x, step=state["step"] + chunk), ev
+
+        class FakeRT:
+            _run_chunk = {True: runner}
+
+            def init_single(self, seed):
+                return dict(x=jnp.zeros((1,), jnp.int32),
+                            step=jnp.zeros((1,), jnp.int32))
+
+        out = find_divergence(FakeRT(), seed=0, max_steps=64, probe=64)
+        assert out is not None and out["step"] == K, out
+        assert int(out["event"]["step"]) == K
+
 
 class TestInterval:
     def test_missed_tick_behaviors(self):

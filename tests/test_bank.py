@@ -13,6 +13,8 @@ SEEDS = np.arange(8)
 TOTAL = 6 * 100
 
 
+pytestmark = pytest.mark.slow  # measured in --durations; ci.sh fast skips
+
 class TestBank:
     def test_clean_run_conserves(self):
         rt = make_bank_runtime(n_raft=3, n_clients=2, n_ops=6,

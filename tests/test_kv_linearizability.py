@@ -12,6 +12,8 @@ from madsim_tpu.native import check_kv_history, check_register
 PUT, GET = 1, 2
 
 
+pytestmark = pytest.mark.slow  # measured in --durations; ci.sh fast skips
+
 def H(*ops):
     """ops: (op, val, inv, resp) tuples -> checker args."""
     a = np.asarray(ops, np.int64).reshape(-1, 4)

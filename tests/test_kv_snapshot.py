@@ -18,6 +18,10 @@ N_RAFT, N_CLIENTS, N_OPS = 5, 3, 10
 L = 12  # total committed entries (30 ops + no-ops) far exceed the window
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # measured in --durations; ci.sh fast skips
+
 def _cfg(time_limit=sec(12), loss=0.0):
     return SimConfig(n_nodes=N_RAFT + N_CLIENTS, event_capacity=128,
                      payload_words=12, time_limit=time_limit,

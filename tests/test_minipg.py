@@ -14,6 +14,8 @@ from madsim_tpu.models.minipg import make_minipg_runtime
 SEEDS = np.arange(8)
 
 
+pytestmark = pytest.mark.slow  # measured in --durations; ci.sh fast skips
+
 def _cfg(loss=0.0, time_limit=sec(10)):
     return SimConfig(n_nodes=3, event_capacity=64, payload_words=8,
                      time_limit=time_limit,

@@ -26,6 +26,8 @@ N = CLIENTS_BASE + NC
 N_OPS, MAX_CFG, B = 5, 4, 12
 
 
+pytestmark = pytest.mark.slow  # measured in --durations; ci.sh fast skips
+
 def _runtime(scenario=None):
     cfg = SimConfig(n_nodes=N, event_capacity=160, payload_words=12,
                     time_limit=sec(60),

@@ -18,6 +18,8 @@ from madsim_tpu.models.stream_echo import make_stream_echo_runtime
 SEEDS = np.arange(8)
 
 
+pytestmark = pytest.mark.slow  # measured in --durations; ci.sh fast skips
+
 def _cfg(loss=0.0, time_limit=sec(8)):
     return SimConfig(n_nodes=3, event_capacity=64, payload_words=8,
                      time_limit=time_limit,
