@@ -148,11 +148,19 @@ class SimConfig:
     time_limit: int = 10 * TICKS_PER_SEC
     net: NetConfig = dataclasses.field(default_factory=NetConfig)
     collect_stats: bool = True
+    # scheduler backend: "reference" = the unfused XLA reductions
+    # (ops/select.py); "fused" = the Pallas VMEM-pass kernel
+    # (ops/pallas_select.py). Both draw the same-deadline tie-break
+    # uniformly but from DIFFERENT bits, so each value is its own replay
+    # domain — seeds reproduce within a scheduler, not across them (the
+    # config hash covers this field, so a repro line pins it).
+    scheduler: str = "reference"
 
     def __post_init__(self):
         assert self.n_nodes >= 1
         assert self.event_capacity >= 4
         assert self.payload_words >= 1
+        assert self.scheduler in ("reference", "fused")
 
     def hash(self) -> str:
         """Stable 8-hex-digit config hash, printed on test failure so a repro

@@ -40,9 +40,18 @@ MAX_FREE = _COL_OK - _COL_SLOTS  # 56 emission slots — far above any model
 
 
 def _kernel(dl_ref, el_ref, fr_ref, rnd_ref, out_ref, *, n_free, inf):
-    dl = dl_ref[:]
-    el = el_ref[:] != 0
-    fr = fr_ref[:] != 0
+    _body(dl_ref[:], el_ref[:] != 0, fr_ref[:] != 0, rnd_ref, out_ref,
+          n_free=n_free, inf=inf)
+
+
+def _kernel_nofree(dl_ref, el_ref, rnd_ref, out_ref, *, inf):
+    # select-only variant: no free-mask input at all — the engine's lane
+    # entry must not DMA a dummy buffer into VMEM on the hot path
+    _body(dl_ref[:], el_ref[:] != 0, None, rnd_ref, out_ref,
+          n_free=0, inf=inf)
+
+
+def _body(dl, el, fr, rnd_ref, out_ref, *, n_free, inf):
     rnd = rnd_ref[:, :1]                       # [BB, 1] per-lane random bits
     bb, cc = dl.shape
     lane = jax.lax.broadcasted_iota(jnp.int32, (bb, cc), 1)
@@ -101,40 +110,78 @@ def fused_schedule(deadlines, eligible, free, rand_bits, *, n_free: int,
     ok[B, n_free]) with ops/select semantics (tie-break draw differs; see
     module docstring).
     """
-    from jax.experimental import pallas as pl
-
     assert n_free <= MAX_FREE, f"n_free > {MAX_FREE} packed-output slots"
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    B, C = deadlines.shape
-    BB = -(-B // 8) * 8
-    CC = -(-C // 128) * 128
-    pad = ((0, BB - B), (0, CC - C))
-    dl = jnp.pad(jnp.asarray(deadlines, jnp.int32), pad,
-                 constant_values=inf)
-    el = jnp.pad(eligible.astype(jnp.int32), pad)
-    fr = jnp.pad(free.astype(jnp.int32), pad)
-    rnd = jnp.pad(jnp.broadcast_to(
-        jnp.asarray(rand_bits, jnp.int32)[:, None], (B, 128)),
-        ((0, BB - B), (0, 0)))
-
-    kern = functools.partial(_kernel, n_free=n_free, inf=inf)
-    out = pl.pallas_call(
-        kern,
-        grid=(BB // 8,),
-        in_specs=[pl.BlockSpec((8, CC), lambda i: (i, 0)),
-                  pl.BlockSpec((8, CC), lambda i: (i, 0)),
-                  pl.BlockSpec((8, CC), lambda i: (i, 0)),
-                  pl.BlockSpec((8, 128), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BB, 128), jnp.int32),
-        interpret=interpret,
-    )(dl, el, fr, rnd)
-
-    out = out[:B]
+    out = _fused_call(deadlines, eligible, free, rand_bits, rows=8,
+                      n_free=n_free, inf=inf, interpret=interpret)
     dmin = out[:, _COL_DMIN]
     idx = out[:, _COL_IDX]
     any_el = out[:, _COL_ANY] == 1
     slots = out[:, _COL_SLOTS:_COL_SLOTS + n_free]
     ok = out[:, _COL_OK:_COL_OK + n_free] == 1
     return dmin, idx, any_el, slots, ok
+
+
+@functools.partial(jax.jit, static_argnames=("inf", "interpret"))
+def fused_select_lane(deadlines, eligible, rand_bits, *, inf: int,
+                      interpret: bool | None = None):
+    """Per-trajectory fused select (no free-scan): the vmappable entry the
+    engine uses under `SimConfig(scheduler="fused")`.
+
+    Args: deadlines int32[C], eligible bool[C], rand_bits int32 scalar.
+    Returns (dmin, idx, any_eligible) scalars. Same semantics as
+    `sel.min_deadline` + `sel.masked_choice` but the tie-break draw
+    differs (hash priorities vs masked categorical — both uniform; each
+    scheduler value is its own replay domain).
+
+    vmap over the seed batch lifts the pallas_call with a batching rule
+    (one grid row per lane); a [1, C] block avoids the batched entry's
+    8-row padding, which under vmap would cost 8x waste per lane. The
+    free-mask input is omitted entirely (n_free=0) — no dummy buffer DMA
+    on the hot path.
+    """
+    out = _fused_call(deadlines[None], eligible[None], None,
+                      jnp.asarray(rand_bits, jnp.int32)[None], rows=1,
+                      n_free=0, inf=inf, interpret=interpret)
+    return out[0, _COL_DMIN], out[0, _COL_IDX], out[0, _COL_ANY] == 1
+
+
+def _fused_call(deadlines, eligible, free, rand_bits, *, rows: int,
+                n_free: int, inf: int, interpret: bool | None):
+    """Shared plumbing for both entries: pad to (rows, 128) tiles, build
+    the pallas_call, return packed [B, 128] output rows. `free=None`
+    selects the no-free-input kernel variant."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    B, C = deadlines.shape
+    BB = -(-B // rows) * rows
+    CC = -(-C // 128) * 128
+    pad = ((0, BB - B), (0, CC - C))
+    table_spec = pl.BlockSpec((rows, CC), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((rows, 128), lambda i: (i, 0))
+
+    dl = jnp.pad(jnp.asarray(deadlines, jnp.int32), pad,
+                 constant_values=inf)
+    el = jnp.pad(eligible.astype(jnp.int32), pad)
+    rnd = jnp.pad(jnp.broadcast_to(
+        jnp.asarray(rand_bits, jnp.int32)[:, None], (B, 128)),
+        ((0, BB - B), (0, 0)))
+    if free is None:
+        kern = functools.partial(_kernel_nofree, inf=inf)
+        ins, specs = (dl, el, rnd), [table_spec, table_spec, out_spec]
+    else:
+        kern = functools.partial(_kernel, n_free=n_free, inf=inf)
+        fr = jnp.pad(free.astype(jnp.int32), pad)
+        ins = (dl, el, fr, rnd)
+        specs = [table_spec, table_spec, table_spec, out_spec]
+
+    out = pl.pallas_call(
+        kern,
+        grid=(BB // rows,),
+        in_specs=specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((BB, 128), jnp.int32),
+        interpret=interpret,
+    )(*ins)
+    return out[:B]

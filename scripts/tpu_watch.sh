@@ -14,12 +14,16 @@ for i in $(seq 1 200); do
     echo "$(date -u +%H:%M:%S) running combined --all" >> tpu_watch.log
     python bench.py --all > BENCH_tpu_all.json 2>> tpu_watch.log
     echo "$(date -u +%H:%M:%S) --all done rc=$?" >> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) running scheduler A/B" >> tpu_watch.log
+    python bench.py --sched-ab > BENCH_tpu_sched_ab.json 2>> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) sched-ab done rc=$?" >> tpu_watch.log
     echo "$(date -u +%H:%M:%S) running tuning sweep" >> tpu_watch.log
     python bench.py --sweep > BENCH_tpu_sweep.json 2>> tpu_watch.log
     echo "$(date -u +%H:%M:%S) sweep done rc=$?" >> tpu_watch.log
     git add BENCH_tpu.json BENCH_tpu_all.json BENCH_tpu_sweep.json \
-        BENCH_TPU_LAST.json tpu_watch.log 2>> tpu_watch.log
-    git commit -m "Record on-chip bench artifacts (flagship + combined --all + sweep)" \
+        BENCH_tpu_sched_ab.json BENCH_TPU_LAST.json tpu_watch.log \
+        2>> tpu_watch.log
+    git commit -m "Record on-chip bench artifacts (flagship + --all + scheduler A/B + sweep)" \
         >> tpu_watch.log 2>&1
     echo "$(date -u +%H:%M:%S) artifacts committed" >> tpu_watch.log
     exit 0

@@ -36,6 +36,51 @@ def _reference(deadlines, eligible, free, E):
     return jax.vmap(one)(deadlines, eligible, free)
 
 
+def test_lane_entry_matches_reference_under_vmap():
+    # the engine's actual entry (SimConfig(scheduler="fused")): per-lane,
+    # lifted over the seed batch by vmap's pallas batching rule
+    from madsim_tpu.ops.pallas_select import fused_select_lane
+
+    rng = np.random.default_rng(7)
+    B, C = 12, 96
+    dl, el, _, rnd = _random_tables(rng, B, C)
+    dmin, idx, any_el = jax.vmap(
+        lambda d, e, r: fused_select_lane(d, e, r, inf=INF))(dl, el, rnd)
+    rdmin, rat_min, rany, _, _ = _reference(dl, el, jnp.zeros_like(el), 1)
+
+    mask = np.asarray(rany)
+    np.testing.assert_array_equal(np.asarray(any_el), mask)
+    np.testing.assert_array_equal(np.asarray(dmin)[mask],
+                                  np.asarray(rdmin)[mask])
+    at = np.asarray(rat_min)
+    for b in range(B):
+        if mask[b]:
+            assert at[b, int(np.asarray(idx)[b])]
+
+
+def test_fused_scheduler_end_to_end():
+    # the flag is real: a chaos workload completes, replays bit-stable,
+    # and varies schedules by seed under the fused scheduler
+    from madsim_tpu import Runtime, Scenario, SimConfig, ms, sec
+    from madsim_tpu.models.pingpong import PingPong, state_spec
+
+    n = 3
+    sc = Scenario()
+    sc.at(ms(5)).kill_random()
+    sc.at(ms(200)).restart_random()
+    cfg = SimConfig(n_nodes=n, time_limit=sec(10), scheduler="fused")
+    rt = Runtime(cfg, [PingPong(n, target=4, retry=ms(20))], state_spec(),
+                 scenario=sc)
+    state, _ = rt.run(rt.init_batch(np.arange(32)), max_steps=4000)
+    assert bool(state.halted.all()) and not bool(state.crashed.any())
+    assert len(set(np.asarray(state.sched_hash).tolist())) >= 16
+    assert rt.check_determinism(seed=5, max_steps=4000)
+    # distinct replay domain: the reference scheduler on the same seed
+    # yields a DIFFERENT config hash, so repro lines pin the scheduler
+    ref_cfg = SimConfig(n_nodes=n, time_limit=sec(10))
+    assert cfg.hash() != ref_cfg.hash()
+
+
 @pytest.mark.parametrize("B,C,E", [(16, 96, 6), (8, 200, 12), (3, 40, 4)])
 def test_matches_reference(B, C, E):
     rng = np.random.default_rng(42)
