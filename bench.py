@@ -27,7 +27,7 @@ STEPS = 1024        # timed steps
 CPU_STEPS = 512     # timed steps for the single-seed CPU baseline
 
 
-def _make_runtime(scheduler: str = "reference"):
+def _make_runtime(scheduler: str = "reference", table_dtype: str = "int32"):
     from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
     from madsim_tpu.models.raft import make_raft_runtime
 
@@ -37,7 +37,7 @@ def _make_runtime(scheduler: str = "reference"):
     # ops dominate the step, so a tight table is a direct speedup
     cfg = SimConfig(n_nodes=n, event_capacity=96, time_limit=sec(600),
                     net=NetConfig(packet_loss_rate=0.05),
-                    scheduler=scheduler)
+                    scheduler=scheduler, table_dtype=table_dtype)
     sc = Scenario()
     for t in range(8):  # rolling chaos, one cycle per simulated second
         sc.at(sec(1 + t)).kill_random()
@@ -288,23 +288,28 @@ def _all_mode():
 
 
 def _sched_ab_mode():
-    """--sched-ab: A/B the fused Pallas scheduler against the unfused
-    reference path on the flagship workload, same platform/batch — the
-    data that decides VERDICT r2 weak #2. Meaningful on the chip (off-TPU
-    the kernel runs interpreted and measures nothing)."""
+    """--sched-ab: A/B the two engine perf levers on the flagship
+    workload, same platform/batch — the data that decides VERDICT r2
+    weak #2: the fused Pallas scheduler vs the unfused reference path,
+    and int16 vs int32 table columns (the latter is bit-identical in
+    results, pure bandwidth). Meaningful on the chip (off-TPU the kernel
+    runs interpreted and measures nothing)."""
     import jax
     platform = jax.devices()[0].platform
     out = {"metric": "scheduler_ab", "platform": platform, "batch": B_TPU,
            "variants": {}}
     for sched in ("reference", "fused"):
-        try:
-            eps = _events_per_sec(B_TPU, STEPS, WARM,
-                                  make=lambda: _make_runtime(sched))
-            out["variants"][sched] = round(eps, 1)
-            print(f"--sched-ab: {sched} {eps:,.0f} seed-events/s",
-                  file=sys.stderr)
-        except Exception as e:  # noqa: BLE001 - partial evidence > none
-            out["variants"][sched] = f"{type(e).__name__}: {e}"
+        for dtype in ("int32", "int16"):
+            name = f"{sched}/{dtype}"
+            try:
+                eps = _events_per_sec(
+                    B_TPU, STEPS, WARM,
+                    make=lambda: _make_runtime(sched, dtype))
+                out["variants"][name] = round(eps, 1)
+                print(f"--sched-ab: {name} {eps:,.0f} seed-events/s",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 - partial evidence > none
+                out["variants"][name] = f"{type(e).__name__}: {e}"
     print(json.dumps(out))
 
 

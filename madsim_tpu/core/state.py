@@ -89,6 +89,9 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
     leading [N] axis (Runtime stacks the per-node spec)."""
     C, P, N = cfg.event_capacity, cfg.payload_words, cfg.n_nodes
     i32 = jnp.int32
+    # narrow columns (cfg.table_dtype): same values, half the bytes —
+    # t_tag/t_deadline/t_payload stay int32 (29-bit tags, time, data)
+    ti = jnp.int16 if cfg.table_dtype == "int16" else jnp.int32
     return SimState(
         now=jnp.asarray(0, i32),
         key=key,
@@ -101,9 +104,9 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         sched_hash=jnp.asarray(2166136261, jnp.uint32),   # FNV offset basis
         tlimit=jnp.asarray(cfg.time_limit, i32),
         t_deadline=jnp.full((C,), T.T_INF, i32),
-        t_kind=jnp.zeros((C,), i32),
-        t_node=jnp.zeros((C,), i32),
-        t_src=jnp.zeros((C,), i32),
+        t_kind=jnp.zeros((C,), ti),
+        t_node=jnp.zeros((C,), ti),
+        t_src=jnp.zeros((C,), ti),
         t_tag=jnp.zeros((C,), i32),
         t_payload=jnp.zeros((C, P), i32),
         alive=jnp.zeros((N,), bool),

@@ -164,7 +164,7 @@ def make_step(
             now=now,
             sched_hash=sched_hash,
             t_kind=sel.put_row(s.t_kind, idx,
-                               jnp.asarray(T.EV_FREE, jnp.int32), valid),
+                               jnp.asarray(T.EV_FREE, s.t_kind.dtype), valid),
             t_deadline=sel.put_row(s.t_deadline, idx,
                                    jnp.asarray(T.T_INF, jnp.int32), valid),
         )
@@ -306,7 +306,9 @@ def make_step(
                 ohi = slot_oh.astype(v.dtype)
                 if v.ndim == 1:
                     upd = (ohi * v[:, None]).sum(0)
-                    return jnp.where(written, upd, col)
+                    # cast, not promote: staged values are int32 but the
+                    # column may be a narrow (table_dtype) dtype
+                    return jnp.where(written, upd, col).astype(col.dtype)
                 upd = jnp.einsum("ec,ep->cp", ohi, v)
                 return jnp.where(written[:, None], upd, col)
 
@@ -366,9 +368,12 @@ def make_step(
             halted=s.halted | halted_now | crash,
         )
 
+        # records always int32: table_dtype is an internal bandwidth
+        # lever and must not leak into the trace schema
         record = dict(
-            now=s.now, kind=ev_kind.astype(jnp.int32), node=ev_node,
-            src=ev_src, tag=ev_tag, payload=ev_payload,
+            now=s.now, kind=ev_kind.astype(jnp.int32),
+            node=ev_node.astype(jnp.int32), src=ev_src.astype(jnp.int32),
+            tag=ev_tag.astype(jnp.int32), payload=ev_payload,
             fired=valid,
         )
         if extensions:

@@ -155,12 +155,22 @@ class SimConfig:
     # domain — seeds reproduce within a scheduler, not across them (the
     # config hash covers this field, so a repro line pins it).
     scheduler: str = "reference"
+    # narrow event-table columns: "int16" stores t_kind/t_node/t_src in
+    # half the bytes (the [batch, C] table dominates step cost — DESIGN
+    # §5b; t_tag stays int32: service tags are 29-bit hashes, t_deadline
+    # is virtual time). Values are identical either way, so trajectories
+    # and fingerprints are BIT-IDENTICAL across this knob — a pure
+    # bandwidth lever, not a replay domain.
+    table_dtype: str = "int32"
 
     def __post_init__(self):
         assert self.n_nodes >= 1
         assert self.event_capacity >= 4
         assert self.payload_words >= 1
         assert self.scheduler in ("reference", "fused")
+        assert self.table_dtype in ("int32", "int16")
+        if self.table_dtype == "int16":
+            assert self.n_nodes < 2**15, "int16 t_node caps nodes at 32767"
 
     def hash(self) -> str:
         """Stable 8-hex-digit config hash, printed on test failure so a repro

@@ -117,8 +117,10 @@ class Runtime:
         tag[n_init:n_rows] = rows["op"]
         payload[n_init:n_rows] = rows["payload"]
         return s.replace(
-            t_deadline=jnp.asarray(deadline), t_kind=jnp.asarray(kind),
-            t_node=jnp.asarray(node), t_src=jnp.asarray(src),
+            t_deadline=jnp.asarray(deadline),
+            t_kind=jnp.asarray(kind, s.t_kind.dtype),       # table_dtype
+            t_node=jnp.asarray(node, s.t_node.dtype),
+            t_src=jnp.asarray(src, s.t_src.dtype),
             t_tag=jnp.asarray(tag), t_payload=jnp.asarray(payload))
 
     # ------------------------------------------------------------------
@@ -271,11 +273,14 @@ class Runtime:
                 t_deadline=state.t_deadline.at[slot].set(
                     jnp.where(w, state.now, state.t_deadline[slot])),
                 t_kind=state.t_kind.at[slot].set(
-                    jnp.where(w, Ty.EV_SUPER, state.t_kind[slot])),
+                    jnp.where(w, Ty.EV_SUPER,
+                              state.t_kind[slot]).astype(state.t_kind.dtype)),
                 t_node=state.t_node.at[slot].set(
-                    jnp.where(w, node, state.t_node[slot])),
+                    jnp.where(w, node,
+                              state.t_node[slot]).astype(state.t_node.dtype)),
                 t_src=state.t_src.at[slot].set(
-                    jnp.where(w, src, state.t_src[slot])),
+                    jnp.where(w, src,
+                              state.t_src[slot]).astype(state.t_src.dtype)),
                 t_tag=state.t_tag.at[slot].set(
                     jnp.where(w, op, state.t_tag[slot])),
                 t_payload=state.t_payload.at[slot].set(

@@ -256,6 +256,32 @@ class TestRandomTargets:
         assert len(set(victims.tolist())) >= 2      # still random within it
 
 
+class TestNarrowTableColumns:
+    def test_int16_columns_bit_identical_to_int32(self):
+        # table_dtype is a pure bandwidth lever: t_kind/t_node/t_src in
+        # int16 must yield BIT-IDENTICAL trajectories (values unchanged,
+        # fingerprints cover every leaf's values)
+        from madsim_tpu import Scenario
+        from madsim_tpu.core.types import sec as _sec
+        from madsim_tpu.utils.hashing import fingerprint
+
+        def run(dtype):
+            n = 4
+            sc = Scenario()
+            sc.at(ms(5)).kill_random()
+            sc.at(ms(300)).restart_random()
+            cfg = SimConfig(n_nodes=n, time_limit=_sec(2),
+                            net=NetConfig(packet_loss_rate=0.1),
+                            table_dtype=dtype)
+            rt = Runtime(cfg, [PingPong(n, target=4, retry=ms(20))],
+                         state_spec(), scenario=sc)
+            state, _ = rt.run(rt.init_batch(np.arange(64)), max_steps=4000)
+            assert bool(state.halted.all())
+            return np.asarray(jax.vmap(fingerprint)(state))
+
+        np.testing.assert_array_equal(run("int32"), run("int16"))
+
+
 class TestContinuationIdiom:
     """A handler is atomic here (a deliberate transform of madsim's
     poll-level interleaving, DESIGN.md §3); `ctx.defer` splits a
