@@ -1,0 +1,69 @@
+"""Coverage-driven schedule exploration — loop-until-dry seed sweeps.
+
+The reference's only exploration lever is "run more seeds": a FIXED
+iteration count via `MADSIM_TEST_NUM` (madsim-macros/src/lib.rs:152-167),
+with no way to know whether the extra seeds bought new schedules. With
+the per-trajectory dispatch-order hash (`SimState.sched_hash`) the
+batched engine can measure that directly: sweep successive seed batches
+and stop when consecutive rounds stop producing schedules never seen
+before — spend device time where coverage still grows, stop when the
+schedule space (as the hash observes it) is saturated.
+
+Crashes don't abort the sweep: every distinct crash code is collected
+with its first seed (the repro handle), because a fuzzing run wants the
+full harvest, not the first kill.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
+            dry_rounds: int = 2, base_seed: int = 0, chunk: int = 512):
+    """Sweep seed batches until `dry_rounds` consecutive rounds add no
+    new distinct schedule (or `max_rounds` is hit).
+
+    Returns a dict:
+      seeds_run            total seeds executed
+      rounds               rounds executed
+      distinct_schedules   cumulative distinct sched_hash values
+      new_per_round        schedules first seen in each round (the
+                           saturation curve — diagnostic for how much a
+                           bigger sweep could still buy)
+      saturated            True if the dry-round stop fired
+      crash_first_seed_by_code   {crash_code: first seed} repro handles
+      crashes              total crashed trajectories
+    """
+    seen: set[int] = set()
+    crashes: dict[int, int] = {}
+    n_crashed = 0
+    new_per_round: list[int] = []
+    dry = 0
+    rounds = 0
+    for r in range(max_rounds):
+        seeds = np.arange(base_seed + r * batch, base_seed + (r + 1) * batch,
+                          dtype=np.uint32)
+        state, _ = rt.run(rt.init_batch(seeds), max_steps, chunk)
+        hashes = np.asarray(state.sched_hash).tolist()
+        crashed = np.asarray(state.crashed)
+        codes = np.asarray(state.crash_code)
+        for i in np.nonzero(crashed)[0]:
+            crashes.setdefault(int(codes[i]), int(seeds[i]))
+        n_crashed += int(crashed.sum())
+        new = len(set(hashes) - seen)
+        seen.update(hashes)
+        new_per_round.append(new)
+        rounds += 1
+        dry = dry + 1 if new == 0 else 0
+        if dry >= dry_rounds:
+            break
+    return dict(
+        seeds_run=rounds * batch,
+        rounds=rounds,
+        distinct_schedules=len(seen),
+        new_per_round=new_per_round,
+        saturated=dry >= dry_rounds,
+        crash_first_seed_by_code=crashes,
+        crashes=n_crashed,
+    )

@@ -36,6 +36,28 @@ class TestGossip:
         delayed = (np.asarray(state.now) > sec(2))
         assert delayed.mean() >= 0.75, delayed
 
+    def test_64_node_cluster_with_multiword_partition(self):
+        # width test: a 64-node cluster — node ids span THREE payload
+        # words — survives a partition whose membership mask and a
+        # random-kill pool both live beyond word 0 (the r3 multi-word
+        # packing), and still fully disseminates after the heal
+        n = 64
+        cfg = SimConfig(n_nodes=n, event_capacity=640, time_limit=sec(60),
+                        net=NetConfig(packet_loss_rate=0.05))
+        sc = Scenario()
+        sc.at(ms(50)).partition(range(32, 64))     # words 1-2 membership
+        sc.at(ms(80)).kill_random(among=range(40, 48))  # pool in word 1
+        sc.at(sec(2)).heal()
+        sc.at(sec(2) + ms(100)).restart_random()
+        rt = make_gossip_runtime(n_nodes=n, n_rumors=4, scenario=sc,
+                                 cfg=cfg, require_all_alive=True)
+        state = run_seeds(rt, np.arange(8), max_steps=120_000)
+        have = np.asarray(state.node_state["have"])
+        assert (have == 15).all()
+        # the cut delayed dissemination past the heal in most lanes —
+        # i.e. the word-1/2 partition mask actually bit
+        assert (np.asarray(state.now) > sec(2)).mean() >= 0.75
+
     def test_restart_gets_reinfected(self):
         # kill mid-dissemination and restart shortly after: the restarted
         # node comes back AMNESIC (volatile state) and must be re-infected

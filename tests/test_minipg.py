@@ -98,7 +98,8 @@ class TestRealWorld:
     asyncio sockets (the dual-world contract)."""
 
     @pytest.mark.parametrize("transport,port", [("udp", 19500),
-                                                ("tcp", 19520)])
+                                                ("tcp", 19520),
+                                                ("local", 19540)])
     def test_minipg_over_real_sockets(self, transport, port):
         from madsim_tpu.models.minipg import (PgClient, PgServer,
                                               pg_state_spec)

@@ -281,9 +281,9 @@ class TestRealProcessDeath:
         return [int(v) for v in rt.states()[0]["kv"]]
 
     def test_synced_writes_survive_kill9(self, tmp_path):
-        acked = self._run_child_until_acked(str(tmp_path), 19500, "sync",
+        acked = self._run_child_until_acked(str(tmp_path), 19600, "sync",
                                             min_acked=2)
-        kv = self._recover_kv(str(tmp_path), 19520)
+        kv = self._recover_kv(str(tmp_path), 19620)
         # every write the client saw acked must be on disk: node 1 owns
         # keys 0..1 and writes strictly increasing values per key
         assert kv[0] >= acked[0] and kv[1] >= acked[1], (kv, acked)
@@ -293,7 +293,7 @@ class TestRealProcessDeath:
         # the disk never got — kill -9 must lose them (wal_cap > n_ops so
         # no checkpoint ever syncs the table). Proves the sync gate is
         # load-bearing in the REAL world too, mirroring tests/test_fs.py.
-        acked = self._run_child_until_acked(str(tmp_path), 19540, "nosync",
+        acked = self._run_child_until_acked(str(tmp_path), 19640, "nosync",
                                             min_acked=1)
-        kv = self._recover_kv(str(tmp_path), 19560)
+        kv = self._recover_kv(str(tmp_path), 19660)
         assert kv[0] < acked[0], (kv, acked)      # the lost write
