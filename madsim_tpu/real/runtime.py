@@ -269,11 +269,23 @@ class RealRuntime:
             self._halted.set()
 
     # -- entry point ----------------------------------------------------
-    async def _main(self, duration: float):
+    async def start(self, nodes: Sequence[int] | None = None):
+        """Begin real-time execution on the CURRENT event loop: bind the
+        loop (timers dispatch via call_later), zero the clock origin, and
+        start the given nodes (default: all).
+
+        The public entry for custom supervisor scripts — tests and demos
+        await this, then drive kill/restart/pause between awaits (the
+        block_on-a-supervisor-future shape, runtime/mod.rs:119) — and for
+        single-node boots like recovery inspection (start just the
+        server, read its recovered state)."""
         self._loop = asyncio.get_running_loop()
         self.t0 = time.monotonic()
-        for i in range(self.cfg.n_nodes):
+        for i in (range(self.cfg.n_nodes) if nodes is None else nodes):
             await self.start_node(i)
+
+    async def _main(self, duration: float):
+        await self.start()
         try:
             await asyncio.wait_for(self._halted.wait(), timeout=duration)
         except asyncio.TimeoutError:

@@ -50,11 +50,7 @@ def main():
         data_dir=data_dir)
 
     async def scenario():
-        rt._loop = asyncio.get_running_loop()
-        import time
-        rt.t0 = time.monotonic()
-        for i in range(2):
-            await rt.start_node(i)
+        await rt.start()
         while True:                     # parent SIGKILLs us mid-loop
             await asyncio.sleep(0.02)
             acked = [int(v) for v in rt.nodes[1].state["acked"]]

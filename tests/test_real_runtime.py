@@ -51,10 +51,7 @@ class TestRealWorld:
                          state_spec(), base_port=19340)
 
         async def scenario():
-            rt._loop = asyncio.get_running_loop()
-            rt.t0 = __import__("time").monotonic()
-            for i in range(n):
-                await rt.start_node(i)
+            await rt.start()
             await asyncio.sleep(0.15)
             rt.kill(1)
             await asyncio.sleep(0.4)
@@ -94,10 +91,7 @@ class TestRealTcp:
                          base_port=19380, transport="tcp")
 
         async def scenario():
-            rt._loop = asyncio.get_running_loop()
-            rt.t0 = __import__("time").monotonic()
-            for i in range(3):
-                await rt.start_node(i)
+            await rt.start()
             await asyncio.sleep(0.2)
             rt.kill(0)                       # connections die for real
             await asyncio.sleep(0.3)
@@ -135,10 +129,7 @@ class TestRealDurability:
                          base_port=19420, persist=wal_persist_spec())
 
         async def scenario():
-            rt._loop = asyncio.get_running_loop()
-            rt.t0 = __import__("time").monotonic()
-            for i in range(2):
-                await rt.start_node(i)
+            await rt.start()
             await asyncio.sleep(0.25)
             rt.kill(0)                    # power-fail the server for real
             await asyncio.sleep(0.25)
@@ -271,10 +262,7 @@ class TestRealProcessDeath:
             base_port=port, persist=wal_persist_spec(), data_dir=data_dir)
 
         async def boot():
-            import time as _time
-            rt._loop = asyncio.get_running_loop()
-            rt.t0 = _time.monotonic()
-            await rt.start_node(0)
+            await rt.start(nodes=[0])   # server only: recovery, no new ops
             rt.kill(0)
 
         asyncio.run(boot())
