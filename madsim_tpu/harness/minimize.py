@@ -1,0 +1,84 @@
+"""Chaos-script minimization: shrink a failing scenario to a minimal repro.
+
+A failing seed from a fuzz sweep comes with the whole chaos script that
+produced it — rolling kills, partitions, latency flips — most of which is
+noise. This ddmin-style pass deletes scenario rows while the SAME seed
+still crashes with the SAME code, converging to a 1-minimal script: every
+remaining row is load-bearing (dropping any one of them makes the crash
+vanish). The reference has nothing like this; its repro is "same seed,
+same code, same config hash" with the full test body
+(madsim-macros/src/lib.rs:188-190).
+
+Cheap by construction: a scenario is initial-state data, not program
+(`Runtime.set_scenario` rebuilds the state template without retracing),
+so each candidate costs one single-lane run of the already-compiled step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..runtime.scenario import Scenario
+
+
+def _crash_code(rt, seed: int, max_steps: int, chunk: int):
+    """-> crash code of the single-lane run, or None if it didn't crash."""
+    state, _ = rt.run(rt.init_single(seed), max_steps, chunk,
+                      collect_events=False)
+    if not bool(np.asarray(state.crashed).any()):
+        return None
+    return int(np.asarray(state.crash_code).reshape(-1)[0])
+
+
+def minimize_scenario(rt, seed: int, max_steps: int, chunk: int = 512):
+    """Shrink `rt.scenario` to a 1-minimal script that still crashes
+    `seed` with the original crash code.
+
+    Returns (minimal: Scenario, info: dict) and leaves `rt` restored to
+    its original scenario. info carries kept/dropped row counts, the
+    number of candidate runs executed, and the crash code.
+    """
+    from ..core import types as T
+
+    original = rt.scenario
+    rows = list(original.rows)
+    code = _crash_code(rt, seed, max_steps, chunk)
+    if code is None:
+        raise ValueError(
+            f"seed {seed} does not crash under the full scenario — "
+            f"nothing to minimize")
+    runs = 1
+    try:
+        # greedy 1-minimal pass to fixpoint: try deleting each row; keep
+        # the deletion if the same crash still reproduces. Chunked first
+        # passes (halves, quarters) would cut runs for big scripts, but
+        # scripts are tens of rows and each run is milliseconds-to-
+        # seconds on an already-compiled program. HALT rows are pinned:
+        # set_scenario would re-add one at cfg.time_limit, so "deleting"
+        # a user HALT would silently test a longer virtual-time horizon
+        # than the script being minimized.
+        changed = True
+        while changed:
+            changed = False
+            i = 0
+            while i < len(rows):
+                if rows[i].op == T.OP_HALT:
+                    i += 1
+                    continue
+                cand = Scenario()
+                cand.rows = rows[:i] + rows[i + 1:]
+                rt.set_scenario(cand)
+                runs += 1
+                if _crash_code(rt, seed, max_steps, chunk) == code:
+                    rows = cand.rows         # row i was noise
+                    changed = True
+                else:
+                    i += 1                   # row i is load-bearing
+    finally:
+        rt.set_scenario(original)
+    minimal = Scenario()
+    minimal.rows = rows
+    return minimal, dict(
+        kept=len(rows), dropped=len(original.rows) - len(rows),
+        runs=runs, crash_code=code,
+    )

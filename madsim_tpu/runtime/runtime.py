@@ -54,20 +54,39 @@ class Runtime:
         self.node_prog = np.asarray(
             node_prog if node_prog is not None
             else np.zeros(cfg.n_nodes, np.int32), np.int32)
-        # copy the scenario so the auto-HALT never mutates a caller's object
-        # that might be shared across Runtimes with different time limits
-        self.scenario = Scenario()
-        if scenario is not None:
-            self.scenario.rows = list(scenario.rows)
-        if not self.scenario.has_halt():
-            self.scenario.at(cfg.time_limit).halt()
         self.invariant = invariant
         self.extensions = list(extensions)
         self._step = make_step(cfg, self.programs, self.node_prog,
                                self.state_spec, invariant, persist=persist,
                                halt_when=halt_when,
                                extensions=self.extensions)
-        self._template = self._build_template()
+        self.set_scenario(scenario)
+
+    def set_scenario(self, scenario: Scenario | None) -> None:
+        """Swap the scheduled supervisor script WITHOUT recompiling.
+
+        A scenario is initial-state DATA (event-table rows pre-loaded by
+        `_build_template`), not part of the compiled step program — so
+        replacing it never retraces. Copies the rows (the auto-HALT must
+        never mutate a caller's object that might be shared across
+        Runtimes with different time limits) and re-applies the auto-HALT
+        at cfg.time_limit when the script has none. `harness.minimize`
+        uses this to ddmin failing chaos scripts."""
+        new = Scenario()
+        if scenario is not None:
+            new.rows = list(scenario.rows)
+        if not new.has_halt():
+            new.at(self.cfg.time_limit).halt()
+        # build first, assign together: a capacity-overflow ValueError
+        # must not leave rt.scenario describing a script the template
+        # doesn't encode
+        old = getattr(self, "scenario", None)
+        self.scenario = new
+        try:
+            self._template = self._build_template()
+        except Exception:
+            self.scenario = old
+            raise
 
     # ------------------------------------------------------------------
     def _build_template(self) -> SimState:

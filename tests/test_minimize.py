@@ -1,0 +1,87 @@
+"""Chaos-script minimization: a failing seed's scenario shrinks to the
+load-bearing rows, and the shrunken script still reproduces."""
+
+import numpy as np
+
+from madsim_tpu import Scenario, ms
+from madsim_tpu.harness.minimize import minimize_scenario
+from madsim_tpu.models import wal_kv
+from madsim_tpu.models.wal_kv import make_wal_kv_runtime
+
+
+def _chaos(pairs):
+    sc = Scenario()
+    for t in range(pairs):
+        sc.at(ms(150) + ms(250) * t).kill(0)
+        sc.at(ms(210) + ms(250) * t).restart(0)
+    return sc
+
+
+class TestMinimize:
+    def test_shrinks_and_still_reproduces(self):
+        # 6 kill/restart pairs of power-fail chaos on the unsynced-WAL
+        # red case: most pairs are noise — losing THE acked write needs
+        # one well-placed kill and a restart for the client's GET to
+        # observe it
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                                 sync_wal=False, scenario=_chaos(6))
+        seed = 0                         # known red (tests/test_fs.py)
+        minimal, info = minimize_scenario(rt, seed, max_steps=60_000)
+
+        assert info["crash_code"] == wal_kv.CRASH_LOST_WRITE
+        assert info["kept"] < info["kept"] + info["dropped"]  # shrank
+        assert info["kept"] <= 6, info    # most chaos rows were noise
+        # rt restored: the full script is back in place
+        assert len(rt.scenario.rows) == info["kept"] + info["dropped"]
+
+        # the shrunken script reproduces in a FRESH runtime
+        rt2 = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                                  sync_wal=False, scenario=minimal)
+        st, _ = rt2.run(rt2.init_single(seed), 60_000,
+                        collect_events=False)
+        assert bool(np.asarray(st.crashed).any())
+        assert int(np.asarray(st.crash_code).reshape(-1)[0]) \
+            == wal_kv.CRASH_LOST_WRITE
+
+        # 1-minimality: every surviving row is load-bearing (HALT rows
+        # are pinned by the minimizer — set_scenario re-adds one — so
+        # they're exempt from the droppability check)
+        from madsim_tpu.core import types as T
+        for i in range(len(minimal.rows)):
+            if minimal.rows[i].op == T.OP_HALT:
+                continue
+            sub = Scenario()
+            sub.rows = minimal.rows[:i] + minimal.rows[i + 1:]
+            rt2.set_scenario(sub)
+            st, _ = rt2.run(rt2.init_single(seed), 60_000,
+                            collect_events=False)
+            crashed = bool(np.asarray(st.crashed).any())
+            code = int(np.asarray(st.crash_code).reshape(-1)[0])
+            assert not (crashed and code == wal_kv.CRASH_LOST_WRITE), \
+                f"row {i} of the minimal script is droppable"
+
+    def test_green_scenario_refuses(self):
+        import pytest
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=8, wal_cap=8,
+                                 sync_wal=True, scenario=_chaos(2))
+        with pytest.raises(ValueError, match="does not crash"):
+            minimize_scenario(rt, seed=3, max_steps=40_000)
+
+    def test_set_scenario_overflow_rolls_back(self):
+        # a capacity-overflowing script must not leave the runtime with
+        # rt.scenario describing rows the state template doesn't encode
+        import pytest
+
+        from madsim_tpu import SimConfig, sec
+        from madsim_tpu.models.pingpong import PingPong, state_spec
+        from madsim_tpu.runtime.runtime import Runtime
+
+        cfg = SimConfig(n_nodes=2, event_capacity=8, time_limit=sec(1))
+        rt = Runtime(cfg, [PingPong(2, target=1)], state_spec())
+        before = rt.scenario
+        big = Scenario()
+        for t in range(20):
+            big.at(ms(t + 1)).kill(0)
+        with pytest.raises(ValueError, match="exceeds event_capacity"):
+            rt.set_scenario(big)
+        assert rt.scenario is before     # old script still in force
