@@ -92,10 +92,24 @@ def run_seeds(rt: Runtime, seeds, max_steps: int, chunk: int = 512,
     crashed = np.asarray(state.crashed)
     if crashed.any():
         i = int(np.argmax(crashed))
+        msg = f"({int(crashed.sum())}/{len(seeds)} seeds crashed)"
+        if os.environ.get("MADSIM_TEST_MINIMIZE"):
+            # opt-in ddmin of the chaos script (one compiled run per
+            # candidate row). Overrides aren't threaded into the
+            # minimizer's replays, so under MADSIM_TEST_CONFIG the crash
+            # may not reproduce — report that rather than fail the report
+            try:
+                from .minimize import minimize_scenario
+                minimal, info = minimize_scenario(rt, int(seeds[i]),
+                                                  max_steps, chunk)
+                msg += (f"\nminimal chaos script ({info['kept']} of "
+                        f"{info['kept'] + info['dropped']} rows, "
+                        f"{info['runs']} runs):\n{minimal.describe()}")
+            except Exception as e:  # noqa: BLE001 - repro line still stands
+                msg += f"\n(minimization unavailable: {e})"
         raise SimFailure(
             seeds[i], np.asarray(state.crash_code)[i],
-            np.asarray(state.crash_node)[i], cfg_hash,
-            msg=f"({int(crashed.sum())}/{len(seeds)} seeds crashed)")
+            np.asarray(state.crash_node)[i], cfg_hash, msg=msg)
     oops = np.asarray(state.oops)
     if (oops != 0).any():
         i = int(np.argmax(oops != 0))

@@ -53,6 +53,53 @@ class Scenario:
     def has_halt(self) -> bool:
         return any(r.op == T.OP_HALT for r in self.rows)
 
+    _OP_NAMES = {
+        T.OP_INIT: "boot", T.OP_KILL: "kill", T.OP_RESTART: "restart",
+        T.OP_PAUSE: "pause", T.OP_RESUME: "resume",
+        T.OP_CLOG_NODE: "clog", T.OP_UNCLOG_NODE: "unclog",
+        T.OP_CLOG_LINK: "clog_link", T.OP_UNCLOG_LINK: "unclog_link",
+        T.OP_SET_LOSS: "set_loss", T.OP_SET_LATENCY: "set_latency",
+        T.OP_HEAL: "heal", T.OP_PARTITION: "partition", T.OP_HALT: "halt",
+    }
+
+    @staticmethod
+    def _unpack_members(words):
+        """Inverse of the 31-nodes/word packing (pools, partitions)."""
+        return [w * 31 + b for w, word in enumerate(words)
+                for b in range(31) if (int(word) >> b) & 1]
+
+    def describe(self) -> str:
+        """Faithful one-line-per-row rendering (repro reports): exact
+        tick times, decoded pools/partitions/rates — a script re-entered
+        from this text reproduces the original fault model."""
+        out = []
+        for r in self.rows:
+            name = self._OP_NAMES.get(r.op, f"op{r.op}")
+            if r.node == T.NODE_RANDOM:
+                pool = self._unpack_members(r.payload)
+                tgt = (f"random among {pool}" if pool else "random")
+            else:
+                tgt = f"node {r.node}"
+            extra = ""
+            if r.op in (T.OP_CLOG_LINK, T.OP_UNCLOG_LINK):
+                extra = f" {r.src}->{r.node}"
+                tgt = ""
+            elif r.op == T.OP_PARTITION:
+                tgt = ""
+                extra = f" group_a={self._unpack_members(r.payload)}"
+            elif r.op == T.OP_SET_LOSS:
+                tgt = ""
+                extra = f" rate={r.payload[0] / 1e6:g}"
+            elif r.op == T.OP_SET_LATENCY:
+                tgt = ""
+                extra = (f" latency={r.payload[0]}us"
+                         f"..{r.payload[1]}us")
+            elif r.op == T.OP_HALT:
+                tgt = ""
+            out.append(f"  t={r.time}us {name}"
+                       f"{' ' + tgt if tgt else ''}{extra}")
+        return "\n".join(out)
+
     def build(self, cfg: T.SimConfig):
         """-> dict of numpy arrays (time, op, node, src, payload[R, P])."""
         R = len(self.rows)

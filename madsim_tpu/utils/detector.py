@@ -1,0 +1,77 @@
+"""Heartbeat failure detection as reusable state-machine helpers.
+
+The reference ships NO failure detector — applications roll their own
+heartbeats over the simulated network (SURVEY §5: "apps implement their
+own heartbeats"). This module makes the pattern a component: fixed-shape
+helpers a `Program` calls from its handlers, so any protocol gains a
+timeout-based suspect list (the classic eventually-perfect-detector
+construction: suspect after `timeout` of silence, rehabilitate on any
+message) without hand-rolling the bookkeeping.
+
+State contract — embed via `detector_state(n_nodes)` in the state spec:
+  fd_last  int32[N]  virtual time a heartbeat/message was last seen from
+                     each peer (self entry is refreshed by `beat`)
+  fd_susp  int32[N]  1 while a peer is suspected
+
+Usage inside a Program (see tests/test_detector.py for a full model):
+    init:       `reset(st, ctx.now)` (boot grace period — also how a
+                restarted node starts from silence, not t=0); arm a
+                periodic FD_TICK timer; `beat(ctx)` broadcasts
+    on_message: `saw(st, src, ctx.now)` on ANY message (heartbeats and
+                protocol traffic both prove liveness)
+    on_timer:   `st["fd_susp"] = suspects(st, ctx.now, timeout)`;
+                re-arm; optionally react to flips (leader demotion etc.)
+
+All helpers are masked tensor ops — they vectorize under vmap and cost a
+few VPU instructions; no gathers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+TAG_HEARTBEAT = (1 << 29) | 0x5EA7  # above the 29-bit service-tag space
+
+
+def detector_state(n_nodes: int):
+    """State-spec fragment: merge into the program's spec dict."""
+    return dict(
+        fd_last=jnp.zeros((n_nodes,), jnp.int32),
+        fd_susp=jnp.zeros((n_nodes,), jnp.int32),
+    )
+
+
+def reset(st, now, *, when=True):
+    """Boot/restart grace period: count every peer as just-seen at `now`.
+    Call from `Program.init` — it also makes a RESTARTED node measure
+    silence from its rebirth instead of suspecting the world because its
+    zeroed memory says everyone was last seen at t=0."""
+    st["fd_last"] = jnp.where(when, jnp.full_like(st["fd_last"], now),
+                              st["fd_last"])
+    st["fd_susp"] = jnp.where(when, jnp.zeros_like(st["fd_susp"]),
+                              st["fd_susp"])
+    return st
+
+
+def saw(st, src, now, *, when=True):
+    """Record proof of life from `src` at `now` (call on ANY message)."""
+    n = st["fd_last"].shape[0]
+    oh = jnp.arange(n, dtype=jnp.int32) == src
+    st["fd_last"] = jnp.where(oh & when, jnp.maximum(st["fd_last"], now),
+                              st["fd_last"])
+    return st
+
+
+def beat(ctx, n_nodes: int, *, when=True):
+    """Broadcast a heartbeat to every peer (skips self)."""
+    for d in range(n_nodes):
+        ctx.send(d, TAG_HEARTBEAT, when=when & (ctx.node != d))
+
+
+def suspects(st, now, timeout):
+    """-> int32[N] suspicion mask: 1 where `timeout` has elapsed since a
+    peer's last proof of life. Pure function of the recorded state, so
+    callers can also compute hypotheticals (different timeouts) without
+    extra bookkeeping. A node never suspects itself if it refreshed its
+    own `fd_last` via `saw(st, ctx.node, now)` each tick."""
+    return (now - st["fd_last"] > timeout).astype(jnp.int32)

@@ -1,0 +1,78 @@
+"""Failure detection: the heartbeat/suspect helpers driving a monitored
+cluster — kill flips the victim into every survivor's suspect set within
+a bounded delay, restart rehabilitates it."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu import Program, Runtime, Scenario, SimConfig, NetConfig, ms
+from madsim_tpu.core.types import sec
+from madsim_tpu.utils import detector as fd
+
+FD_TICK = 1
+N = 5
+PERIOD = ms(50)
+TIMEOUT = ms(200)
+
+
+class Monitored(Program):
+    """Every node heartbeats and maintains its suspect mask."""
+
+    def init(self, ctx):
+        st = dict(ctx.state)
+        st = fd.reset(st, ctx.now)      # boot grace period
+        ctx.set_timer(ctx.randint(0, PERIOD), FD_TICK)
+        ctx.state = st
+
+    def on_timer(self, ctx, tag, payload):
+        st = dict(ctx.state)
+        tick = tag == FD_TICK
+        st = fd.saw(st, ctx.node, ctx.now, when=tick)    # self-refresh
+        fd.beat(ctx, N, when=tick)
+        st["fd_susp"] = jnp.where(tick,
+                                  fd.suspects(st, ctx.now, TIMEOUT),
+                                  st["fd_susp"])
+        ctx.set_timer(PERIOD, FD_TICK, when=tick)
+        ctx.state = st
+
+    def on_message(self, ctx, src, tag, payload):
+        st = dict(ctx.state)
+        st = fd.saw(st, src, ctx.now, when=tag == fd.TAG_HEARTBEAT)
+        ctx.state = st
+
+
+def _run(scenario, until, seeds=32):
+    cfg = SimConfig(n_nodes=N, event_capacity=160, time_limit=until,
+                    net=NetConfig(packet_loss_rate=0.05))
+    rt = Runtime(cfg, [Monitored()], fd.detector_state(N),
+                 scenario=scenario)
+    state, _ = rt.run(rt.init_batch(np.arange(seeds)), max_steps=40_000)
+    assert bool(state.halted.all()) and not bool(state.crashed.any())
+    return np.asarray(state.node_state["fd_susp"]), np.asarray(state.alive)
+
+
+class TestDetector:
+    def test_clean_cluster_never_suspects(self):
+        susp, _ = _run(None, until=sec(2))
+        assert (susp == 0).all()
+
+    def test_kill_is_detected_by_all_survivors(self):
+        sc = Scenario()
+        sc.at(sec(1)).kill(2)
+        susp, alive = _run(sc, until=sec(2))
+        assert (~alive[:, 2]).all()
+        others = [i for i in range(N) if i != 2]
+        # every survivor suspects the victim (>= TIMEOUT+PERIOD elapsed)
+        assert (susp[:, others, 2] == 1).all()
+        # and nobody suspects a live node
+        assert (susp[:, others][:, :, others] == 0).all()
+
+    def test_restart_rehabilitates(self):
+        sc = Scenario()
+        sc.at(sec(1)).kill(2)
+        sc.at(sec(2)).restart(2)
+        susp, alive = _run(sc, until=sec(3))
+        assert alive[:, 2].all()
+        # victim beats again: suspicion cleared everywhere, and the
+        # restarted node (whose memory died) doesn't suspect anyone
+        assert (susp == 0).all()

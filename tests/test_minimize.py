@@ -67,6 +67,23 @@ class TestMinimize:
         with pytest.raises(ValueError, match="does not crash"):
             minimize_scenario(rt, seed=3, max_steps=40_000)
 
+    def test_env_knob_adds_minimal_script_to_failure(self, monkeypatch):
+        # MADSIM_TEST_MINIMIZE=1: the SimFailure report carries the
+        # ddmin'd chaos script in human-readable form
+        import pytest
+
+        from madsim_tpu.harness.simtest import SimFailure, run_seeds
+
+        monkeypatch.setenv("MADSIM_TEST_MINIMIZE", "1")
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                                 sync_wal=False, scenario=_chaos(6))
+        with pytest.raises(SimFailure) as ei:
+            run_seeds(rt, np.arange(8), max_steps=60_000)
+        msg = str(ei.value)
+        assert "minimal chaos script" in msg
+        assert "kill node 0" in msg and "restart node 0" in msg
+        assert "MADSIM_TEST_SEED=" in msg          # repro line intact
+
     def test_set_scenario_overflow_rolls_back(self):
         # a capacity-overflowing script must not leave the runtime with
         # rt.scenario describing rows the state template doesn't encode
