@@ -12,6 +12,20 @@ from __future__ import annotations
 import numpy as np
 
 
+def schedule_representatives(state, seeds=None) -> dict:
+    """{sched_hash: first seed that produced it} — one replayable
+    representative per distinct interleaving class. After a sweep, replay
+    just these with `Runtime.run_single` to see every distinct behavior
+    the batch explored instead of eyeballing thousands of near-duplicate
+    trajectories."""
+    hashes = np.asarray(state.sched_hash)
+    seeds = (np.asarray(seeds) if seeds is not None
+             else np.arange(hashes.shape[0]))
+    # return_index gives first-occurrence indices: first seed wins
+    uniq, idx = np.unique(hashes, return_index=True)
+    return dict(zip(uniq.tolist(), seeds[idx].tolist()))
+
+
 def summarize(rt, state, seeds=None) -> dict:
     """One-call fleet report for a (finished or running) batched state."""
     halted = np.asarray(state.halted)
@@ -53,9 +67,10 @@ def summarize(rt, state, seeds=None) -> dict:
         distinct_outcomes=int(len(np.unique(fps))),
         # schedule-space coverage proper: distinct dispatch ORDERS — the
         # batched form of task.rs:572-596's "N seeds -> N schedules".
-        # Always >= distinct_outcomes in information content: trajectories
-        # that interleave differently but converge to one terminal state
-        # still count as distinct explored schedules.
+        # Coarser than distinct_outcomes (fingerprints cover sched_hash
+        # plus all payload/state differences) but it answers the coverage
+        # question directly: how many INTERLEAVINGS did the batch explore,
+        # independent of what values flowed through them.
         distinct_schedules=int(
             len(np.unique(np.asarray(state.sched_hash)))),
         oops=int((np.asarray(state.oops) != 0).sum()),

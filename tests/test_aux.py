@@ -133,8 +133,24 @@ class TestStats:
         s = summarize(rt, state)
         assert s["batch"] == 16 and s["halted"] == 16 and s["crashed"] == 0
         assert s["distinct_outcomes"] >= 12      # schedule diversity
+        # outcomes refine schedules: fingerprints cover sched_hash too
+        assert 1 <= s["distinct_schedules"] <= s["distinct_outcomes"]
         assert s["msgs_sent"] > 0 and s["events_total"] > 0
         assert s["first_crash_seed"] is None
+
+    def test_schedule_representatives(self):
+        from madsim_tpu.parallel.stats import schedule_representatives
+        rt = _rt(target=5)
+        seeds = np.arange(100, 116)
+        state, _ = rt.run(rt.init_batch(seeds), 4000)
+        reps = schedule_representatives(state, seeds)
+        hashes = np.asarray(state.sched_hash).tolist()
+        assert len(reps) == len(set(hashes))     # one per distinct class
+        assert set(reps.values()) <= set(seeds.tolist())
+        # each representative is the FIRST seed with that hash
+        for h, s in reps.items():
+            first = seeds[hashes.index(h)]
+            assert s == int(first)
 
 
 class TestCompaction:
