@@ -8,24 +8,30 @@
 cd /root/repo
 for i in $(seq 1 200); do
   if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform not in ('cpu','none')" 2>/dev/null; then
-    echo "$(date -u +%H:%M:%S) tunnel alive, running bench" >> tpu_watch.log
-    python bench.py > BENCH_tpu.json 2>> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) bench done rc=$?" >> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) running combined --all" >> tpu_watch.log
-    python bench.py --all > BENCH_tpu_all.json 2>> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) --all done rc=$?" >> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) running scheduler A/B" >> tpu_watch.log
-    python bench.py --sched-ab > BENCH_tpu_sched_ab.json 2>> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) sched-ab done rc=$?" >> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) running tuning sweep" >> tpu_watch.log
-    python bench.py --sweep > BENCH_tpu_sweep.json 2>> tpu_watch.log
-    echo "$(date -u +%H:%M:%S) sweep done rc=$?" >> tpu_watch.log
-    git add BENCH_tpu.json BENCH_tpu_all.json BENCH_tpu_sweep.json \
-        BENCH_tpu_sched_ab.json BENCH_TPU_LAST.json tpu_watch.log \
-        2>> tpu_watch.log
-    git commit -m "Record on-chip bench artifacts (flagship + --all + scheduler A/B + sweep)" \
-        >> tpu_watch.log 2>&1
-    echo "$(date -u +%H:%M:%S) artifacts committed" >> tpu_watch.log
+    echo "$(date -u +%H:%M:%S) tunnel alive, running bench chain" >> tpu_watch.log
+    # commit after EVERY stage: if the tunnel wedges mid-chain (the bench
+    # runs deliberately have no timeout), the stages already captured
+    # survive as commits instead of dying with the stuck watcher
+    for pair in ":BENCH_tpu.json" "--all:BENCH_tpu_all.json" \
+                "--sched-ab:BENCH_tpu_sched_ab.json" \
+                "--sweep:BENCH_tpu_sweep.json"; do
+      mode="${pair%%:*}"; out="${pair#*:}"
+      echo "$(date -u +%H:%M:%S) running bench $mode -> $out" >> tpu_watch.log
+      python bench.py $mode > "$out" 2>> tpu_watch.log
+      rc=$?
+      echo "$(date -u +%H:%M:%S) bench $mode done rc=$rc" >> tpu_watch.log
+      if [ $rc -eq 0 ] && [ -s "$out" ]; then
+        # -f: some BENCH_tpu_* names are gitignored as scratch; on-chip
+        # evidence must be committed regardless. Guarded on rc/size so a
+        # failed stage never clobbers previously committed good numbers.
+        git add -f "$out" BENCH_TPU_LAST.json tpu_watch.log >> tpu_watch.log 2>&1
+        git commit -m "Record on-chip bench artifact: ${mode:-flagship}" \
+            >> tpu_watch.log 2>&1
+      else
+        git checkout -- "$out" 2>> tpu_watch.log || true
+      fi
+    done
+    echo "$(date -u +%H:%M:%S) bench chain complete" >> tpu_watch.log
     exit 0
   fi
   echo "$(date -u +%H:%M:%S) probe $i: tunnel dead" >> tpu_watch.log
