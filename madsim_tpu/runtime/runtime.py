@@ -271,6 +271,26 @@ class Runtime:
         state = self.init_single(seed)
         return self.run(state, max_steps, chunk, collect_events)
 
+    def state_at(self, seed: int, step: int):
+        """Time travel: the exact state after `step` events of `seed`.
+
+        Decomposes `step` into power-of-two chunks so at most log2(step)
+        distinct chunk lengths ever compile (each cached per Runtime) —
+        an arbitrary step count never costs an arbitrary-length compile.
+        Pair with `find_divergence` / `run_single(collect_events=True)`:
+        localize a step, then inspect the full cluster state right there.
+        """
+        state = self.init_single(seed)
+        remaining = int(step)
+        runner = self._run_chunk[False]
+        while remaining > 0:
+            c = 1 << (remaining.bit_length() - 1)   # largest pow2 <=
+            state, _ = runner(state, c)
+            remaining -= c
+            if bool(state.halted.all()):   # fixed point: stop scanning
+                break
+        return state
+
     # ------------------------------------------------------------------
     # Imperative supervisor surface (Handle::kill/... runtime/mod.rs:200-256)
     # for host-driven scenarios: injects a supervisor op into every

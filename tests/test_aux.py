@@ -61,6 +61,15 @@ class TestDivergence:
         rt = _rt(target=5)
         assert find_divergence(rt, seed=3, max_steps=2000) is None
 
+    def test_state_at_matches_chunked_run(self):
+        # time travel lands on the exact step regardless of chunking:
+        # state_at(seed, k) == running k steps in one arbitrary chunk
+        rt = _rt(target=8)
+        for k in (1, 37, 100):
+            direct, _ = rt.run(rt.init_single(3), max_steps=k, chunk=k)
+            tt = rt.state_at(3, k)
+            assert rt.fingerprints(direct)[0] == rt.fingerprints(tt)[0], k
+
     def test_binary_search_localizes_exact_step(self):
         # red path with a duck-typed runtime whose "replica B" (every odd
         # runner call — find_divergence alternates A,B strictly) perturbs
