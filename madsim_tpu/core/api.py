@@ -67,6 +67,7 @@ class Ctx:
         self._key = key
         self._sends: list[dict[str, Any]] = []
         self._timers: list[dict[str, Any]] = []
+        self._cancels: list[dict[str, Any]] = []
         self._crash = jnp.asarray(False)
         self._crash_code = jnp.asarray(0, jnp.int32)
         self._halt = jnp.asarray(False)
@@ -117,6 +118,26 @@ class Ctx:
             delay=jnp.maximum(jnp.asarray(delay, jnp.int32), 0),
             tag=jnp.asarray(tag, jnp.int32),
             payload=as_payload(payload, self.cfg.payload_words),
+        ))
+
+    def cancel_timer(self, tag, *, when=True) -> None:
+        """Drop ALL of this node's pending timers carrying `tag` (the
+        Sleep::reset / JoinHandle::abort analog, sleep.rs:44-55,
+        task.rs:401-420).
+
+        The freed event-table rows are reusable by this same handler's
+        emissions. Protocols that re-arm retry timers per attempt can
+        cancel the stale ones instead of letting them fire as no-ops —
+        an event-table-pressure relief valve; the alternative idiom
+        (call-id payloads that make stale firings no-ops) remains valid
+        and replay-compatible.
+        """
+        from ..utils.maskutil import statically_false
+        if statically_false(when):
+            return
+        self._cancels.append(dict(
+            m=jnp.asarray(when) & jnp.asarray(True),
+            tag=jnp.asarray(tag, jnp.int32),
         ))
 
     def defer(self, tag, payload=None, *, when=True) -> None:

@@ -58,6 +58,8 @@ EMPTY_SEND = lambda P: dict(
 EMPTY_TIMER = lambda P: dict(
     m=jnp.asarray(False), delay=jnp.asarray(0, jnp.int32),
     tag=jnp.asarray(0, jnp.int32), payload=jnp.zeros((P,), jnp.int32))
+EMPTY_CANCEL = lambda: dict(
+    m=jnp.asarray(False), tag=jnp.asarray(0, jnp.int32))
 
 
 def make_step(
@@ -222,8 +224,10 @@ def make_step(
         halt_req = jnp.asarray(False)
         n_sends = max((len(c._sends) for _, c in combos), default=0)
         n_timers = max((len(c._timers) for _, c in combos), default=0)
+        n_cancels = max((len(c._cancels) for _, c in combos), default=0)
         sends = [EMPTY_SEND(P) for _ in range(n_sends)]
         timers = [EMPTY_TIMER(P) for _ in range(n_timers)]
+        cancels = [EMPTY_CANCEL() for _ in range(n_cancels)]
         for m, ctx in combos:
             new_slice = _where_tree(m, ctx.state, new_slice)
             crash = crash | (m & ctx._crash)
@@ -235,9 +239,21 @@ def make_step(
             for j, e in enumerate(ctx._timers):
                 e = dict(e, m=e["m"] & m)
                 timers[j] = _where_tree(m, e, timers[j])
+            for j, e in enumerate(ctx._cancels):
+                e = dict(e, m=e["m"] & m)
+                cancels[j] = _where_tree(m, e, cancels[j])
 
         s = s.replace(
             node_state=_scatter_node(s.node_state, h_node, new_slice, any_h))
+
+        # timer cancellation first: freed rows are reusable by this same
+        # handler's emissions below (Sleep::reset / abort analog)
+        for e in cancels:
+            hit = (e["m"] & (s.t_kind == T.EV_TIMER)
+                   & (s.t_node == h_node) & (s.t_tag == e["tag"]))
+            s = s.replace(
+                t_kind=jnp.where(hit, T.EV_FREE, s.t_kind),
+                t_deadline=jnp.where(hit, T.T_INF, s.t_deadline))
 
         # ---- 4. materialize emissions into the event table ----------------
         # All emissions are staged into [E]-vectors and written with ONE

@@ -53,7 +53,7 @@ class RealNode:
         self.alive = False
         self.paused = False
         self.parked: list = []         # events deferred while paused
-        self.timers: list[asyncio.TimerHandle] = []
+        self.timers: list[tuple[int, asyncio.TimerHandle]] = []  # (tag, h)
 
 
 class RealRuntime:
@@ -174,8 +174,8 @@ class RealRuntime:
         n.alive = False
         n.paused = False
         n.parked.clear()
-        for t in n.timers:
-            t.cancel()
+        for _, h in n.timers:
+            h.cancel()
         n.timers.clear()
         self._net.close_node(i)
 
@@ -253,15 +253,40 @@ class RealRuntime:
             # real send: straight to the peer; latency, loss, and
             # reordering are whatever the real backend does
             self._net.send(n.id, dst, pkt)
+        for e in ctx._cancels:
+            if not bool(e["m"]):
+                continue
+            # Sleep::reset/abort analog: wall-clock timers really cancel.
+            # Also purge matching timer events parked by pause() — their
+            # handles are already spent, but the event must not fire at
+            # resume (narrows the inherent wall-clock-vs-virtual-schedule
+            # divergence; exact schedule equivalence across worlds is
+            # not a goal — the real world has no tie-break scheduler).
+            t = int(e["tag"])
+            for tag_i, h in n.timers:
+                if tag_i == t:
+                    h.cancel()
+            n.timers = [(tg, h) for tg, h in n.timers if tg != t]
+            n.parked = [(kind, args) for kind, args in n.parked
+                        if not (kind == "timer" and int(args[0]) == t)]
         for e in ctx._timers:
             if not bool(e["m"]):
                 continue
             delay = int(e["delay"]) / T.TICKS_PER_SEC
             tag = jnp.asarray(int(e["tag"]), jnp.int32)
             payload = e["payload"]
-            h = self._loop.call_later(
-                delay, self._dispatch, n.id, "timer", tag, payload)
-            n.timers.append(h)
+            entry = []
+
+            def fire(n=n, tag=tag, payload=payload, entry=entry):
+                # self-prune: spent handles must not accumulate (a
+                # periodic timer would otherwise grow the list per fire)
+                if entry and entry[0] in n.timers:
+                    n.timers.remove(entry[0])
+                self._dispatch(n.id, "timer", tag, payload)
+
+            h = self._loop.call_later(delay, fire)
+            entry.append((int(e["tag"]), h))
+            n.timers.append(entry[0])
         if bool(ctx._crash):
             self.crashed.append((n.id, int(ctx._crash_code)))
             self._halted.set()

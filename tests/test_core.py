@@ -256,6 +256,45 @@ class TestRandomTargets:
         assert len(set(victims.tolist())) >= 2      # still random within it
 
 
+class CancelDemo(Program):
+    """Arms a long SLOW timer, then (optionally) cancels it shortly
+    after — the Sleep::reset / abort analog, red/green testable."""
+
+    SLOW, DO_CANCEL = 1, 2
+
+    def __init__(self, do_cancel: bool):
+        self.do_cancel = do_cancel
+
+    def init(self, ctx):
+        ctx.set_timer(ms(500), self.SLOW, when=ctx.node == 0)
+        ctx.set_timer(ms(10), self.DO_CANCEL, when=ctx.node == 0)
+
+    def on_timer(self, ctx, tag, payload):
+        st = dict(ctx.state)
+        st["fired"] = st["fired"] + (tag == self.SLOW)
+        ctx.cancel_timer(self.SLOW,
+                         when=(tag == self.DO_CANCEL) & self.do_cancel)
+        ctx.state = st
+
+
+class TestCancelTimer:
+    def _run(self, do_cancel):
+        cfg = SimConfig(n_nodes=1, time_limit=T.sec(1))
+        rt = Runtime(cfg, [CancelDemo(do_cancel)],
+                     dict(fired=jnp.asarray(0, jnp.int32)))
+        state, _ = rt.run(rt.init_batch(np.arange(16)), max_steps=500)
+        assert bool(state.halted.all()) and not bool(state.crashed.any())
+        assert rt.check_determinism(seed=4, max_steps=500)
+        return np.asarray(state.node_state["fired"])
+
+    def test_cancelled_timer_never_fires(self):
+        assert (self._run(do_cancel=True) == 0).all()
+
+    def test_uncancelled_timer_fires(self):
+        # the control: without the cancel the same program fires
+        assert (self._run(do_cancel=False) == 1).all()
+
+
 class TestNarrowTableColumns:
     def test_int16_columns_bit_identical_to_int32(self):
         # table_dtype is a pure bandwidth lever: t_kind/t_node/t_src in

@@ -158,6 +158,44 @@ class TestRealDurability:
 
 
 @pytest.mark.realworld
+class TestRealCancelTimer:
+    def test_cancel_really_cancels_wall_clock_timer(self):
+        # dual-world parity for ctx.cancel_timer: the asyncio timer is
+        # genuinely cancelled, red/green via the do_cancel knob
+        import jax.numpy as jnp
+
+        from madsim_tpu.core.api import Program
+
+        class CancelDemo(Program):
+            SLOW, DO_CANCEL = 1, 2
+
+            def __init__(self, do_cancel):
+                self.do_cancel = do_cancel
+
+            def init(self, ctx):
+                ctx.set_timer(ms(400), self.SLOW)
+                ctx.set_timer(ms(30), self.DO_CANCEL)
+
+            def on_timer(self, ctx, tag, payload):
+                st = dict(ctx.state)
+                st["fired"] = st["fired"] + (tag == self.SLOW)
+                ctx.cancel_timer(self.SLOW, when=(tag == self.DO_CANCEL)
+                                 & self.do_cancel)
+                ctx.state = st
+
+        def run(do_cancel):
+            cfg = SimConfig(n_nodes=1, time_limit=sec(5))
+            rt = RealRuntime(cfg, [CancelDemo(do_cancel)],
+                             dict(fired=jnp.asarray(0, jnp.int32)),
+                             base_port=19680)
+            rt.run(duration=1.0)
+            return int(rt.states()[0]["fired"])
+
+        assert run(True) == 0
+        assert run(False) == 1
+
+
+@pytest.mark.realworld
 class TestTransportSeam:
     """The std/net/mod.rs:33-49 seam: backends are a registry, not
     if-branches inside the runtime (VERDICT r2 missing #1)."""
