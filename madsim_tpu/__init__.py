@@ -26,7 +26,10 @@ from .core.types import (
     sec,
 )
 from .core.extension import Extension
+from .harness.minimize import minimize_scenario
 from .harness.simtest import SimFailure, run_seeds, simtest
+from .parallel.explore import explore
+from .parallel.stats import schedule_representatives, summarize
 from .runtime.runtime import Runtime
 from .runtime.scenario import Scenario
 
@@ -37,4 +40,5 @@ __all__ = [
     "Runtime", "Scenario", "simtest", "run_seeds", "SimFailure", "ms", "sec",
     "NODE_RANDOM", "EV_MSG", "EV_TIMER", "EV_SUPER", "CRASH_DEADLOCK",
     "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
+    "explore", "minimize_scenario", "summarize", "schedule_representatives",
 ]
