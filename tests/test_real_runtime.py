@@ -158,6 +158,25 @@ class TestRealDurability:
 
 
 @pytest.mark.realworld
+class TestCompiledDispatch:
+    def test_echo_service_compiled(self):
+        # compiled=True routes every event through a jitted handler
+        # (XLA) instead of eager op dispatch — same Programs, same
+        # effects contract, production-ish per-event cost after warmup
+        cfg = SimConfig(n_nodes=3, time_limit=sec(30))
+        rt = RealRuntime(cfg, [EchoServer(), EchoClient(target=5,
+                                                        timeout=ms(150))],
+                         server_state_spec(), node_prog=[0, 1, 1],
+                         base_port=19700, compiled=True)
+        rt.run(duration=20.0)      # first events pay their combo compiles
+        assert not rt.crashed
+        acked = [int(s["acked"]) for s in rt.states()[1:]]
+        assert all(a >= 5 for a in acked), acked
+        assert int(rt.states()[0]["served"]) >= 10
+        assert len(rt._compiled_fns) >= 3   # the combos actually compiled
+
+
+@pytest.mark.realworld
 class TestRealCancelTimer:
     def test_cancel_really_cancels_wall_clock_timer(self):
         # dual-world parity for ctx.cancel_timer: the asyncio timer is
