@@ -69,6 +69,17 @@ def _events_per_sec(batch: int, steps: int, warm: int, make=None) -> float:
     return batch * steps / dt
 
 
+def _force_cpu_inprocess():
+    """Switch THIS process to the host platform. Env vars alone do NOT
+    unpin the sitecustomize-registered TPU platform — the config update
+    (before any jax device touch in this process) is what actually
+    switches; without it a wedged tunnel hangs the first jnp op."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
 def _cpu_env():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PALLAS_AXON_POOL_IPS", None)  # disable TPU sitecustomize hook
@@ -267,10 +278,7 @@ def _all_mode():
     if not (_tpu_alive() or _tpu_alive()):
         print("--all: tpu preflight failed; running batched CPU",
               file=sys.stderr)
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        _force_cpu_inprocess()
     import jax
     platform = jax.devices()[0].platform
     combined = {"metric": "bench_all", "platform": platform,
@@ -312,6 +320,52 @@ def _sched_ab_mode():
                       file=sys.stderr)
             except Exception as e:  # noqa: BLE001 - partial evidence > none
                 out["variants"][name] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+
+
+def _realworld_mode():
+    """--realworld: events/sec of the real-world twin on loopback — the
+    eager-vs-compiled dispatch A/B (RealRuntime(compiled=)). Independent
+    of the TPU: this measures the production-twin path, where the
+    reference's compiled Rust sets the bar."""
+    # the twin runs on the host next to its sockets — never the
+    # accelerator (per-op dispatch to a device would measure PCIe/tunnel
+    # latency, and a wedged tunnel would hang the bench)
+    _force_cpu_inprocess()
+    from madsim_tpu import SimConfig
+    from madsim_tpu.core.types import ms, sec
+    from madsim_tpu.models.rpc_echo import (EchoClient, EchoServer,
+                                            server_state_spec)
+    from madsim_tpu.real.runtime import RealRuntime
+
+    DUR = 6.0
+    out = {"metric": "realworld_dispatch_events_per_sec", "variants": {}}
+    for compiled in (False, True):
+        name = "compiled" if compiled else "eager"
+        try:
+            # a target the run can never finish: throughput-bound, not
+            # workload-bound (the echo client issues back-to-back by
+            # construction — next request on each ack)
+            rt = RealRuntime(
+                SimConfig(n_nodes=2, time_limit=sec(600)),
+                [EchoServer(), EchoClient(target=1_000_000,
+                                          timeout=ms(500))],
+                server_state_spec(), node_prog=[0, 1],
+                base_port=19900 + 20 * int(compiled), compiled=compiled)
+            rt.run(duration=DUR)
+            assert not rt.crashed, rt.crashed   # a crash is not a datum
+            served = int(rt.states()[0]["served"])
+            acked = int(rt.states()[1]["acked"])
+            eps = (served + acked) / DUR
+            out["variants"][name] = round(eps, 1)
+            print(f"--realworld: {name} {eps:,.0f} handler-events/s "
+                  f"(served={served} acked={acked})", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - partial evidence > none
+            out["variants"][name] = f"{type(e).__name__}: {e}"
+    v = out["variants"]
+    if isinstance(v.get("eager"), float) and isinstance(v.get("compiled"),
+                                                        float):
+        out["speedup"] = round(v["compiled"] / max(v["eager"], 1e-9), 2)
     print(json.dumps(out))
 
 
@@ -412,6 +466,9 @@ def main():
     if "--sched-ab" in sys.argv:
         _sched_ab_mode()
         return
+    if "--realworld" in sys.argv:
+        _realworld_mode()
+        return
     if "--scaling" in sys.argv:
         _scaling_mode()
         return
@@ -433,17 +490,12 @@ def main():
     on_tpu = _tpu_alive() or _tpu_alive()
     if not on_tpu:
         # No chip: fall back to batched-on-CPU so the round still records
-        # a real speedup number instead of a traceback. Env vars alone do
-        # NOT unpin the sitecustomize-registered TPU platform — the config
-        # update (before any jax device touch in this process) is what
-        # actually switches; without it this fallback would hang on the
-        # same wedged tunnel the preflight just detected.
+        # a real speedup number instead of a traceback (the fallback
+        # would otherwise hang on the same wedged tunnel the preflight
+        # just detected — see _force_cpu_inprocess).
         print("tpu preflight failed; falling back to batched CPU",
               file=sys.stderr)
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        _force_cpu_inprocess()
 
     batched_eps = _batched_eps_with_retry("tpu" if on_tpu else "cpu")
 

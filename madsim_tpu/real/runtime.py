@@ -117,9 +117,11 @@ class RealRuntime:
         self._net = TRANSPORTS[transport](cfg.n_nodes, base_port,
                                           self._on_packet)
         # compiled dispatch: jit each (program, handler-kind) once and run
-        # events through XLA instead of eager op dispatch (~5-15ms/event
-        # eager vs ~0.1ms compiled after warmup) — the real-mode
-        # performance the reference gets from compiled Rust. Opt-in: the
+        # events through XLA instead of eager op dispatch — measured
+        # 3.4x on the echo workload (bench.py --realworld: ~0.9ms vs
+        # ~3.2ms per handler event on a 1-core box; remaining cost is
+        # jit-call overhead + host sync + asyncio, not the ops) — toward
+        # the real-mode performance the reference gets from Rust. Opt-in: the
         # first event of each combo pays its compile, which short demo
         # runs may not amortize. Programs are trace-safe by construction
         # (they run under vmap+jit in the simulator), so behavior is
