@@ -20,4 +20,7 @@ Each model is a set of `Program` state machines plus invariants and a
   two_phase_commit  — atomic commit with write-ahead state
   gossip            — epidemic broadcast with anti-entropy push-back
   bank              — Jepsen-style transfers with money conservation
+  ministream        — streaming dataflow with Chandy-Lamport-style epoch
+                      barriers + exactly-once commit oracle (the
+                      RisingWave-shaped e2e workload)
 """

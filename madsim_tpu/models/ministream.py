@@ -1,0 +1,236 @@
+"""ministream — a streaming dataflow with barrier-aligned exactly-once
+epochs, the workload shape of the reference's flagship downstream user
+(RisingWave runs its deterministic e2e tests on madsim; README.md:25-33
+names it). The sim framework's job is to break exactly-once — this model
+makes that falsifiable.
+
+Topology (4 nodes):
+
+    source(0) --DATA(idx even)--> mapper(1) --CNT--> sink(3)
+              --DATA(idx odd)---> mapper(2) --CNT-->
+
+Protocol (epoch barriers with upstream replay — the Chandy-Lamport
+pattern streaming engines use for consistent checkpoints):
+  * The source emits one epoch at a time: K records DATA(e, att, idx)
+    split by idx parity, then BARRIER(e, att) to both mappers, and
+    retransmits the whole epoch on a timer until the sink's COMMIT(e)
+    arrives (at-least-once transport under loss).
+  * A mapper accumulates an idx BITMASK per (e, att) — popcount is its
+    record count, immune to duplicate/reordered delivery — and forwards
+    CNT(e, att, count) on barrier ONLY once its residue class is
+    complete: a barrier must never overtake in-flight data. That gate is
+    the alignment invariant, and it is this model's red/green knob
+    (`strict_barrier=False` ships the classic bug: commit on first
+    barrier, records still in flight).
+  * A restarted mapper lost its mask (volatile state); its init HELLO
+    makes the source bump `att` and replay the epoch from scratch; the
+    sink pairs counts only when both carry the same attempt, so a stale
+    pre-restart count can never match a fresh one.
+  * The sink commits epochs strictly in order, re-acks duplicate CNTs of
+    already-committed epochs (COMMIT may be lost), and checks the
+    exactly-once oracle at every commit:
+        crash_if(total != K)   (CRASH_STREAM_LOST_OR_DUP)
+    a lost record undershoots, a double count overshoots.
+
+tests/test_ministream.py: green under loss + mapper kill/restart chaos;
+red (the oracle MUST fire) as soon as `strict_barrier=False` lets a
+barrier pass incomplete data under loss.
+
+Capacity note: K <= 31 (idx bitmask in one int32 word); chaos targets
+mappers (the stateful middle); source/sink are the stable harness edge,
+like wal_kv's client.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.api import Ctx, Program
+from ..core.types import ms
+
+SOURCE, MAP_A, MAP_B, SINK = 0, 1, 2, 3
+
+M_DATA, M_BARRIER, M_CNT, M_COMMIT, M_HELLO = 1, 2, 3, 4, 5
+T_RETX = 1
+
+CRASH_STREAM_LOST_OR_DUP = 401
+
+
+def stream_state_spec():
+    z = jnp.asarray(0, jnp.int32)
+    return dict(
+        # source
+        s_epoch=z, s_att=z, s_done=z,
+        # mapper (volatile by design: a kill erases the epoch's progress)
+        m_mask=z, m_e=z, m_att=z,
+        # sink
+        k_cnt=jnp.zeros((2,), jnp.int32),     # per-mapper count
+        k_att=jnp.full((2,), -1, jnp.int32),  # attempt each count carries
+        k_have=jnp.zeros((2,), jnp.int32),    # count present this epoch
+        k_committed=z,                        # epochs committed so far
+    )
+
+
+class Source(Program):
+    def __init__(self, k: int, epochs: int, retx=ms(40)):
+        assert 2 <= k <= 31, "idx bitmask packs into one int32 word"
+        self.K = k
+        self.E = epochs
+        self.retx = retx
+
+    def _emit_epoch(self, ctx: Ctx, st, when):
+        """(Re)send the whole current epoch: K records + barriers.
+        Exactly ONE retransmit chain stays armed: every (re)emission
+        cancels the previous T_RETX before re-arming, so HELLO-triggered
+        replays don't multiply retransmission traffic for the rest of
+        the epoch (ctx.cancel_timer — the Sleep::reset idiom)."""
+        e, att = st["s_epoch"], st["s_att"]
+        for idx in range(self.K):
+            dst = MAP_A if idx % 2 == 0 else MAP_B
+            ctx.send(dst, M_DATA, [e, att, idx], when=when)
+        ctx.send(MAP_A, M_BARRIER, [e, att], when=when)
+        ctx.send(MAP_B, M_BARRIER, [e, att], when=when)
+        ctx.cancel_timer(T_RETX, when=when)
+        ctx.set_timer(self.retx, T_RETX, [e], when=when)
+
+    def init(self, ctx: Ctx):
+        st = dict(ctx.state)
+        self._emit_epoch(ctx, st, when=True)
+        ctx.state = st
+
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        # retransmit while the epoch payload[0] is still uncommitted
+        live = ((tag == T_RETX) & (payload[0] == st["s_epoch"])
+                & (st["s_done"] == 0))
+        self._emit_epoch(ctx, st, when=live)
+        ctx.state = st
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        # a mapper came back amnesic: replay the epoch under a fresh
+        # attempt so a stale partial count can never pair with a new one
+        hello = (tag == M_HELLO) & (st["s_done"] == 0)
+        st["s_att"] = st["s_att"] + hello
+        self._emit_epoch(ctx, st, when=hello)
+
+        # sink committed our current epoch: advance (or finish)
+        commit = (tag == M_COMMIT) & (payload[0] == st["s_epoch"])
+        nxt = st["s_epoch"] + 1
+        st["s_done"] = jnp.where(commit & (nxt >= self.E), 1, st["s_done"])
+        advance = commit & (nxt < self.E)
+        st["s_epoch"] = jnp.where(advance, nxt, st["s_epoch"])
+        st["s_att"] = jnp.where(advance, 0, st["s_att"])
+        self._emit_epoch(ctx, st, when=advance)
+        ctx.state = st
+
+
+class Mapper(Program):
+    def __init__(self, k: int, strict_barrier: bool = True):
+        self.K = k
+        self.strict = strict_barrier
+
+    def init(self, ctx: Ctx):
+        # rebirth: progress is gone; ask the source for an epoch replay
+        ctx.send(SOURCE, M_HELLO)
+
+    def _mine(self, ctx, idx):
+        return jnp.where(ctx.node == MAP_A, idx % 2 == 0, idx % 2 == 1)
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        e, att = payload[0], payload[1]
+        newer = (e > st["m_e"]) | ((e == st["m_e"]) & (att > st["m_att"]))
+        stale = (e < st["m_e"]) | ((e == st["m_e"]) & (att < st["m_att"]))
+
+        is_data_raw = (tag == M_DATA) & self._mine(ctx, payload[2])
+        is_barrier = tag == M_BARRIER
+        # ANY message from a newer (epoch, attempt) advances the key and
+        # resets the mask — including a barrier, so a stale mask can
+        # never masquerade as the new attempt's count
+        adv = (is_data_raw | is_barrier) & newer
+        st["m_mask"] = jnp.where(adv, 0, st["m_mask"])
+        st["m_e"] = jnp.where(adv, e, st["m_e"])
+        st["m_att"] = jnp.where(adv, att, st["m_att"])
+
+        is_data = is_data_raw & ~stale
+        bit = 1 << jnp.clip(payload[2], 0, 30)
+        st["m_mask"] = jnp.where(is_data, st["m_mask"] | bit, st["m_mask"])
+
+        # barrier for the CURRENT (e, att): forward the count. The
+        # strict (correct) gate also requires the residue class to be
+        # COMPLETE — a barrier must not overtake in-flight records; the
+        # retransmission loop will deliver another barrier once it is.
+        # strict_barrier=False ships the classic alignment bug.
+        n_mine = (self.K + jnp.where(ctx.node == MAP_A, 1, 0)) // 2
+        count = jnp.sum((st["m_mask"] >> jnp.arange(31)) & 1,
+                        dtype=jnp.int32)
+        cur_barrier = (is_barrier & (e == st["m_e"])
+                       & (att == st["m_att"]))
+        done = cur_barrier & ((count == n_mine) if self.strict
+                              else jnp.asarray(True))
+        ctx.send(SINK, M_CNT, [st["m_e"], st["m_att"], count], when=done)
+        ctx.state = st
+
+
+class Sink(Program):
+    def __init__(self, k: int, epochs: int):
+        self.K = k
+        self.E = epochs
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        e, att, cnt = payload[0], payload[1], payload[2]
+        slot = jnp.clip(src - MAP_A, 0, 1)
+        is_cnt = tag == M_CNT
+
+        # COMMIT acks can be lost: re-ack stragglers of committed epochs
+        # so the source never wedges waiting for a commit that happened
+        ctx.send(SOURCE, M_COMMIT, [e],
+                 when=is_cnt & (e < st["k_committed"]))
+
+        # counts for the epoch being committed; newest attempt wins
+        cur = is_cnt & (e == st["k_committed"])
+        take = cur & (att >= st["k_att"][slot])
+        st["k_cnt"] = st["k_cnt"].at[slot].set(
+            jnp.where(take, cnt, st["k_cnt"][slot]))
+        st["k_att"] = st["k_att"].at[slot].set(
+            jnp.where(take, att, st["k_att"][slot]))
+        st["k_have"] = st["k_have"].at[slot].set(
+            jnp.where(take, 1, st["k_have"][slot]))
+
+        # barrier ALIGNMENT at the join: both inputs present AND from the
+        # same attempt (a stale pre-restart count never pairs with a
+        # fresh one)
+        both = ((st["k_have"][0] == 1) & (st["k_have"][1] == 1)
+                & (st["k_att"][0] == st["k_att"][1]))
+        total = st["k_cnt"][0] + st["k_cnt"][1]
+        commit = cur & both & (st["k_committed"] < self.E)
+        # THE exactly-once oracle: an aligned epoch must count every
+        # record exactly once — a loss undershoots, a duplicate/stale
+        # count overshoots
+        ctx.crash_if(commit & (total != self.K), CRASH_STREAM_LOST_OR_DUP)
+        ctx.send(SOURCE, M_COMMIT, [st["k_committed"]], when=commit)
+        st["k_committed"] = st["k_committed"] + commit
+        # fresh epoch: clear the alignment slots
+        st["k_cnt"] = jnp.where(commit, jnp.zeros_like(st["k_cnt"]),
+                                st["k_cnt"])
+        st["k_att"] = jnp.where(commit, jnp.full_like(st["k_att"], -1),
+                                st["k_att"])
+        st["k_have"] = jnp.where(commit, jnp.zeros_like(st["k_have"]),
+                                 st["k_have"])
+        ctx.state = st
+
+
+def make_ministream_runtime(k=8, epochs=4, strict_barrier=True,
+                            scenario=None, cfg=None):
+    from ..core.types import NetConfig, SimConfig, sec
+    from ..runtime.runtime import Runtime
+
+    if cfg is None:
+        cfg = SimConfig(n_nodes=4, event_capacity=160, time_limit=sec(60),
+                        net=NetConfig(packet_loss_rate=0.05))
+    progs = [Source(k, epochs), Mapper(k, strict_barrier), Sink(k, epochs)]
+    return Runtime(cfg, progs, stream_state_spec(),
+                   node_prog=[0, 1, 1, 2], scenario=scenario,
+                   halt_when=lambda s: s.node_state["s_done"][SOURCE] == 1)
