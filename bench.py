@@ -266,10 +266,41 @@ def _minipg_mode(emit=True):
     return out
 
 
+def _ministream_mode(emit=True):
+    """--ministream: batched throughput of the streaming-dataflow model
+    (epoch barriers, upstream replay, exactly-once commits) under loss +
+    mapper chaos — the fourth per-workload datapoint."""
+    from madsim_tpu import Scenario, ms
+    from madsim_tpu.models.ministream import (MAP_A, MAP_B,
+                                              make_ministream_runtime)
+
+    B, steps = 2048, 512
+
+    def make():
+        sc = Scenario()
+        for t in range(3):
+            sc.at(ms(300 + 700 * t)).kill_random(among=(MAP_A, MAP_B))
+            sc.at(ms(600 + 700 * t)).restart_random(among=(MAP_A, MAP_B))
+        return make_ministream_runtime(k=8, epochs=64, scenario=sc)
+
+    eps = _events_per_sec(B, steps, WARM, make=make)
+    out = {
+        "metric": "ministream_barrier_seed_events_per_sec",
+        "value": round(eps, 1),
+        "unit": "seed*events/s (epoch barriers + exactly-once commits "
+                "under mapper chaos)",
+        "batch": B,
+    }
+    if emit:
+        print(json.dumps(out))
+    return out
+
+
 def _all_mode():
     """--all: one combined JSON with every workload's batched number on
     the current default platform (flagship raft chaos, shardkv migration,
-    minipg sessions). One tunnel revival captures everything."""
+    minipg sessions, ministream barriers). One tunnel revival captures
+    everything."""
     # bounded preflight FIRST: an in-process jax.devices() against a
     # wedged tunnel blocks forever, before the per-workload try/except
     # could ever help — and the watcher runs --all with no timeout. If
@@ -287,7 +318,8 @@ def _all_mode():
             ("madraft_fuzz", lambda: {"value": round(
                 _events_per_sec(B_TPU, STEPS, WARM), 1), "batch": B_TPU}),
             ("shardkv_migration", lambda: _shardkv_mode(emit=False)),
-            ("minipg_sessions", lambda: _minipg_mode(emit=False))):
+            ("minipg_sessions", lambda: _minipg_mode(emit=False)),
+            ("ministream_barriers", lambda: _ministream_mode(emit=False))):
         try:
             combined["workloads"][name] = fn()
             print(f"--all: {name} done", file=sys.stderr)
@@ -459,6 +491,9 @@ def main():
         return
     if "--minipg" in sys.argv:
         _minipg_mode()
+        return
+    if "--ministream" in sys.argv:
+        _ministream_mode()
         return
     if "--all" in sys.argv:
         _all_mode()
