@@ -178,7 +178,8 @@ class TestCompiledDispatch:
 
 @pytest.mark.realworld
 class TestRealCancelTimer:
-    def test_cancel_really_cancels_wall_clock_timer(self):
+    @pytest.mark.parametrize("compiled", [False, True])
+    def test_cancel_really_cancels_wall_clock_timer(self, compiled):
         # dual-world parity for ctx.cancel_timer: the asyncio timer is
         # genuinely cancelled, red/green via the do_cancel knob
         import jax.numpy as jnp
@@ -206,7 +207,9 @@ class TestRealCancelTimer:
             cfg = SimConfig(n_nodes=1, time_limit=sec(5))
             rt = RealRuntime(cfg, [CancelDemo(do_cancel)],
                              dict(fired=jnp.asarray(0, jnp.int32)),
-                             base_port=19680)
+                             base_port=19680, compiled=compiled)
+            # compile warmup happens in start() BEFORE the duration
+            # window opens, so both modes fit the same budget
             rt.run(duration=1.0)
             return int(rt.states()[0]["fired"])
 
