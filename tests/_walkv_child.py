@@ -9,7 +9,7 @@ process mid-run — the real-world power-fail the reference's std mode gets
 for free from actual files (std/fs.rs:1-60) and the sim models with
 page-cache-vs-disk views (fs.py).
 
-argv: data_dir base_port sync|nosync
+argv: data_dir base_port sync|nosync [transport]
 """
 
 import asyncio
@@ -36,6 +36,7 @@ from madsim_tpu.real.runtime import RealRuntime
 def main():
     data_dir, base_port, sync_flag = (
         sys.argv[1], int(sys.argv[2]), sys.argv[3])
+    transport = sys.argv[4] if len(sys.argv) > 4 else "udp"
     cfg = SimConfig(n_nodes=2, time_limit=sec(60))
     # wal_cap larger than total ops: no checkpoint fires, so in the
     # nosync world NOTHING ever reaches the disk view — the red case is
@@ -47,7 +48,7 @@ def main():
                      timeout=ms(80), think=ms(5))],
         wal_state_spec(2, 2, 64, 2), node_prog=[0, 1],
         base_port=base_port, persist=wal_persist_spec(),
-        data_dir=data_dir)
+        data_dir=data_dir, transport=transport)
 
     async def scenario():
         await rt.start()

@@ -273,7 +273,8 @@ class TestRealProcessDeath:
     RealRuntime(data_dir=...): fs disk views spilled with fsync + atomic
     rename after every event, reloaded on boot (std/fs.rs:1-60 twin)."""
 
-    def _run_child_until_acked(self, data_dir, port, sync_flag, min_acked):
+    def _run_child_until_acked(self, data_dir, port, sync_flag, min_acked,
+                               transport="udp"):
         import os
         import signal
         import subprocess
@@ -283,7 +284,7 @@ class TestRealProcessDeath:
         child = subprocess.Popen(
             [_sys.executable,
              os.path.join(os.path.dirname(__file__), "_walkv_child.py"),
-             data_dir, str(port), sync_flag],
+             data_dir, str(port), sync_flag, transport],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         last = [0, 0]
         deadline = _time.monotonic() + 30
@@ -328,9 +329,14 @@ class TestRealProcessDeath:
         asyncio.run(boot())
         return [int(v) for v in rt.states()[0]["kv"]]
 
-    def test_synced_writes_survive_kill9(self, tmp_path):
-        acked = self._run_child_until_acked(str(tmp_path), 19600, "sync",
-                                            min_acked=2)
+    @pytest.mark.parametrize("transport,port", [("udp", 19600),
+                                                ("tcp", 19740)])
+    def test_synced_writes_survive_kill9(self, tmp_path, transport, port):
+        # durability is a property of the storage layer, not the wire:
+        # the same oracle must hold over either transport
+        acked = self._run_child_until_acked(str(tmp_path), port, "sync",
+                                            min_acked=2,
+                                            transport=transport)
         kv = self._recover_kv(str(tmp_path), 19620)
         # every write the client saw acked must be on disk: node 1 owns
         # keys 0..1 and writes strictly increasing values per key
