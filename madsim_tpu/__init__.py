@@ -26,6 +26,7 @@ from .core.types import (
     sec,
 )
 from .core.extension import Extension
+from .harness.determinism import find_divergence
 from .harness.minimize import minimize_scenario
 from .harness.simtest import SimFailure, run_seeds, simtest
 from .parallel.explore import explore
@@ -41,4 +42,5 @@ __all__ = [
     "NODE_RANDOM", "EV_MSG", "EV_TIMER", "EV_SUPER", "CRASH_DEADLOCK",
     "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
     "explore", "minimize_scenario", "summarize", "schedule_representatives",
+    "find_divergence",
 ]
