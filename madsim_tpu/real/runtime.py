@@ -166,14 +166,26 @@ class RealRuntime:
     def _save_persist(self, i: int):
         import io
         items, _ = self._persist_items(self.nodes[i].state)
+        vals = [np.asarray(v) for _, v in items]
+        # most events never touch stable storage (fs.py's disk views only
+        # change on sync_all/set_len): skip the serialize+fsync when the
+        # persist leaves are bit-identical to what's already on disk —
+        # a cheap host compare instead of an fsync per dispatched event
+        prev = getattr(self, "_persist_cache", {}).get(i)
+        if prev is not None and len(prev) == len(vals) and all(
+                np.array_equal(a, b) for a, b in zip(prev, vals)):
+            return
         buf = io.BytesIO()
-        np.savez(buf, **{k: np.asarray(v) for k, v in items})
+        np.savez(buf, **{k: v for (k, _), v in zip(items, vals)})
         tmp = self._disk_path(i) + ".tmp"
         with open(tmp, "wb") as f:
             f.write(buf.getvalue())
             f.flush()
             os.fsync(f.fileno())      # the sync in sync_all made durable
         os.replace(tmp, self._disk_path(i))   # atomic: never a torn file
+        if not hasattr(self, "_persist_cache"):
+            self._persist_cache = {}
+        self._persist_cache[i] = vals
 
     def _load_persist(self, i: int, fresh):
         import jax
