@@ -371,33 +371,52 @@ def _realworld_mode():
     from madsim_tpu.real.runtime import RealRuntime
 
     DUR = 6.0
-    out = {"metric": "realworld_dispatch_events_per_sec", "variants": {}}
-    for compiled in (False, True):
-        name = "compiled" if compiled else "eager"
-        try:
-            # a target the run can never finish: throughput-bound, not
-            # workload-bound (the echo client issues back-to-back by
-            # construction — next request on each ack)
-            rt = RealRuntime(
-                SimConfig(n_nodes=2, time_limit=sec(600)),
-                [EchoServer(), EchoClient(target=1_000_000,
-                                          timeout=ms(500))],
-                server_state_spec(), node_prog=[0, 1],
-                base_port=19900 + 20 * int(compiled), compiled=compiled)
-            rt.run(duration=DUR)
-            assert not rt.crashed, rt.crashed   # a crash is not a datum
-            served = int(rt.states()[0]["served"])
-            acked = int(rt.states()[1]["acked"])
-            eps = (served + acked) / DUR
-            out["variants"][name] = round(eps, 1)
-            print(f"--realworld: {name} {eps:,.0f} handler-events/s "
-                  f"(served={served} acked={acked})", file=sys.stderr)
-        except Exception as e:  # noqa: BLE001 - partial evidence > none
-            out["variants"][name] = f"{type(e).__name__}: {e}"
-    v = out["variants"]
-    if isinstance(v.get("eager"), float) and isinstance(v.get("compiled"),
-                                                        float):
-        out["speedup"] = round(v["compiled"] / max(v["eager"], 1e-9), 2)
+    out = {"metric": "realworld_dispatch_events_per_sec",
+           "note": ("asyncio loop + UDP on 1 core bounds all modes — see "
+                    "PARITY §2.2 scope paragraph; batched amortizes the "
+                    "jit call but not the per-slot XLA work or the "
+                    "per-event socket/timer costs"),
+           "workloads": {}}
+    # two workload shapes x three dispatch modes. pingpong (1 client) has
+    # queue depth 1 — batching can't help there by construction; fanout
+    # (16 concurrent clients) is where the drain amortizes.
+    shapes = {"pingpong": 1, "fanout": 16}
+    modes = {"eager": {}, "compiled": {"compiled": True},
+             "batched": {"batch_drain": 64}}
+    port = 19900
+    for wname, n_cli in shapes.items():
+        variants = {}
+        for mname, kw in modes.items():
+            try:
+                # a target the run can never finish: throughput-bound,
+                # not workload-bound (each client issues back-to-back)
+                rt = RealRuntime(
+                    SimConfig(n_nodes=1 + n_cli, time_limit=sec(600)),
+                    [EchoServer(), EchoClient(target=1_000_000,
+                                              timeout=ms(500))],
+                    server_state_spec(), node_prog=[0] + [1] * n_cli,
+                    base_port=port, **kw)
+                if kw.get("batch_drain"):
+                    rt.drain_delay = 0.002   # coalesce for drain depth
+                port += 20
+                rt.run(duration=DUR)
+                assert not rt.crashed, rt.crashed  # a crash is not a datum
+                served = int(rt.states()[0]["served"])
+                acked = sum(int(s["acked"]) for s in rt.states()[1:])
+                eps = (served + acked) / DUR
+                variants[mname] = round(eps, 1)
+                print(f"--realworld: {wname}/{mname} {eps:,.0f} "
+                      f"handler-events/s (served={served})",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 - partial evidence > none
+                variants[mname] = f"{type(e).__name__}: {e}"
+                port += 20
+        if isinstance(variants.get("eager"), float):
+            for m in ("compiled", "batched"):
+                if isinstance(variants.get(m), float):
+                    variants[f"{m}_speedup_vs_eager"] = round(
+                        variants[m] / max(variants["eager"], 1e-9), 2)
+        out["workloads"][wname] = variants
     print(json.dumps(out))
 
 
@@ -475,8 +494,12 @@ def _scaling_mode():
         eps = B * steps / (time.perf_counter() - t0)
         rows.append({"devices": nd, "seed_events_per_sec": round(eps, 1)})
         print(f"  {nd} device(s): {eps:,.0f} seed-events/s", file=sys.stderr)
-    print(json.dumps({"metric": "madraft_fuzz_scaling_cpu_mesh",
-                      "batch": B, "rows": rows}))
+    print(json.dumps({
+        "metric": "spmd_compile_check_cpu_mesh",
+        "note": ("virtual devices on a 1-core host: proves the SPMD "
+                 "program compiles and executes at every mesh width; "
+                 "NOT scaling evidence — no ICI, no real parallelism"),
+        "batch": B, "rows": rows}))
 
 
 def main():

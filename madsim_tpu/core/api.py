@@ -131,6 +131,14 @@ class Ctx:
         an event-table-pressure relief valve; the alternative idiom
         (call-id payloads that make stale firings no-ops) remains valid
         and replay-compatible.
+
+        Ordering within one handler invocation (both worlds agree):
+        ALL cancels are applied BEFORE any of the same invocation's
+        set_timer emissions, regardless of call order in the handler
+        body. So cancel-then-set is the supported re-arm idiom;
+        set-then-cancel of the same tag leaves the NEW timer armed —
+        the cancel only drops timers that existed when the handler
+        began.
         """
         from ..utils.maskutil import statically_false
         if statically_false(when):

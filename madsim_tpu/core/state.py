@@ -38,15 +38,21 @@ class SimState:
     crash_node: jax.Array   # int32 — node implicated, -1 if n/a
     oops: jax.Array         # int32 bitmask — capacity overflows
     steps: jax.Array        # int32 — events dispatched so far
-    sched_hash: jax.Array   # uint32 — running hash of the dispatch sequence
-                            # (kind/node/src/tag of every event, in order).
-                            # Two trajectories with different interleavings
-                            # get different hashes even when they converge
-                            # to the same terminal state — the
+    sched_hash: jax.Array   # uint32[2] — running hash of the dispatch
+                            # sequence (kind/node/src/tag of every event, in
+                            # order). Two trajectories with different
+                            # interleavings get different hashes even when
+                            # they converge to the same terminal state — the
                             # schedule-coverage metric proper, vs the
                             # terminal-fingerprint proxy (task.rs:572-596
                             # asserts N seeds -> N schedules; this is the
-                            # batched measurement of that property)
+                            # batched measurement of that property).
+                            # Two independent 32-bit lanes = 64 effective
+                            # bits: at the 100k-seed fuzz scale a single
+                            # 32-bit lane's birthday collisions (~n²/2³³)
+                            # would undercount distinct_schedules and stop
+                            # explore()'s dry-round loop early. Combine with
+                            # parallel/stats.sched_hash_u64 for analysis.
     tlimit: jax.Array       # int32 ticks — virtual-time limit; DYNAMIC (like
                             # loss/latency) so set_time_limit / the
                             # MADSIM_TEST_TIME_LIMIT env knob need no recompile
@@ -70,6 +76,8 @@ class SimState:
     loss: jax.Array         # float32 — packet_loss_rate
     lat_lo: jax.Array       # int32 ticks — send_latency range
     lat_hi: jax.Array       # int32 ticks
+    jitter: jax.Array       # int32 ticks — per-op micro-jitter bound
+                            # (NetConfig.op_jitter_max; net/mod.rs:151-156)
 
     # --- stats (NetSim::stat analog, network.rs:82-85) --------------------
     msg_sent: jax.Array
@@ -101,7 +109,10 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         crash_node=jnp.asarray(-1, i32),
         oops=jnp.asarray(0, i32),
         steps=jnp.asarray(0, i32),
-        sched_hash=jnp.asarray(2166136261, jnp.uint32),   # FNV offset basis
+        # lane 0: FNV-1a 32 offset basis; lane 1: low half of the FNV-1a 64
+        # offset basis (any distinct odd-ish seed works — the lanes only
+        # need independent trajectories)
+        sched_hash=jnp.asarray([2166136261, 0x84222325], jnp.uint32),
         tlimit=jnp.asarray(cfg.time_limit, i32),
         t_deadline=jnp.full((C,), T.T_INF, i32),
         t_kind=jnp.zeros((C,), ti),
@@ -117,6 +128,7 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         loss=jnp.asarray(cfg.net.packet_loss_rate, jnp.float32),
         lat_lo=jnp.asarray(cfg.net.send_latency_min, i32),
         lat_hi=jnp.asarray(cfg.net.send_latency_max, i32),
+        jitter=jnp.asarray(cfg.net.op_jitter_max, i32),
         msg_sent=jnp.asarray(0, i32),
         msg_delivered=jnp.asarray(0, i32),
         msg_dropped=jnp.asarray(0, i32),

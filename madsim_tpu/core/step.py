@@ -148,11 +148,21 @@ def make_step(
         # randomness, so it cannot perturb replay; distinct interleavings
         # yield distinct hashes even when terminal states coincide.
         u32 = jnp.uint32
-        ev_mix = (ev_kind.astype(u32) * u32(0x9E3779B1)
-                  ^ ev_node.astype(u32) * u32(0x85EBCA77)
-                  ^ ev_src.astype(u32) * u32(0xC2B2AE3D)
-                  ^ ev_tag.astype(u32) * u32(0x27D4EB2F))
-        sched_hash = jnp.where(valid, (s.sched_hash ^ ev_mix) * u32(16777619),
+        # two independent lanes (64 effective bits — see state.py): same
+        # event fields, different multiplier assignment per lane, different
+        # FNV-style folding primes
+        ev_mix = jnp.stack([
+            (ev_kind.astype(u32) * u32(0x9E3779B1)
+             ^ ev_node.astype(u32) * u32(0x85EBCA77)
+             ^ ev_src.astype(u32) * u32(0xC2B2AE3D)
+             ^ ev_tag.astype(u32) * u32(0x27D4EB2F)),
+            (ev_kind.astype(u32) * u32(0x27D4EB2F)
+             ^ ev_node.astype(u32) * u32(0xC2B2AE3D)
+             ^ ev_src.astype(u32) * u32(0x9E3779B1)
+             ^ ev_tag.astype(u32) * u32(0x85EBCA77)),
+        ])
+        fold = jnp.asarray([16777619, 0x85EBCA6B], u32)  # both odd
+        sched_hash = jnp.where(valid, (s.sched_hash ^ ev_mix) * fold,
                                s.sched_hash)
 
         # pop the slot; clock never runs backward (resumed nodes' past-due
@@ -268,7 +278,12 @@ def make_step(
             free = s.t_kind == T.EV_FREE
             occupied_now = (~free).sum(dtype=jnp.int32)
             slots, slot_ok = sel.first_k_free(free, E)
-            net_keys = prng.split(k_net, 2 * max(n_sends, 1))
+            # per-send: loss + latency keys; per-emission (send AND timer):
+            # one micro-jitter key (net/mod.rs:151-156 — the reference
+            # random-delays EVERY network op; with op_jitter_max == 0 the
+            # draw is constant 0 and XLA folds it away)
+            net_keys = prng.split(k_net, 2 * max(n_sends, 1) + E)
+            jit_keys = net_keys[2 * max(n_sends, 1):]
             em_write, em_deadline, em_kind = [], [], []
             em_node, em_tag, em_payload = [], [], []
             src_clog = sel.take1(s.clog_node, h_node)
@@ -281,7 +296,9 @@ def make_step(
                 clogged = (src_clog | sel.take1(s.clog_node, dst)
                            | sel.take1(src_links, dst))
                 lost = prng.bernoulli(net_keys[2 * j], s.loss)
-                latency = prng.randint(net_keys[2 * j + 1], s.lat_lo, s.lat_hi)
+                latency = (prng.randint(net_keys[2 * j + 1], s.lat_lo,
+                                        s.lat_hi)
+                           + prng.randint(jit_keys[j], 0, s.jitter))
                 ok = e["m"] & ~clogged & ~lost
                 sent = sent + e["m"].astype(jnp.int32)
                 delivered_drop = delivered_drop + (e["m"] & ~ok).astype(
@@ -299,7 +316,9 @@ def make_step(
                 write = e["m"] & slot_ok[n_sends + j]
                 overflow = overflow | (e["m"] & ~slot_ok[n_sends + j])
                 em_write.append(write)
-                em_deadline.append(s.now + e["delay"])
+                em_deadline.append(s.now + e["delay"]
+                                   + prng.randint(jit_keys[n_sends + j],
+                                                  0, s.jitter))
                 em_kind.append(jnp.asarray(T.EV_TIMER, jnp.int32))
                 em_node.append(h_node)
                 em_tag.append(e["tag"])

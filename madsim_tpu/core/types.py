@@ -91,6 +91,13 @@ class NetConfig:
     packet_loss_rate: float = 0.0
     send_latency_min: int = 1 * TICKS_PER_MS
     send_latency_max: int = 10 * TICKS_PER_MS
+    # per-op micro-jitter: 0..op_jitter_max ticks (inclusive) added to every
+    # send's latency draw AND every timer's deadline — the analog of the
+    # reference's random 0-5 us delay before each network op
+    # (net/mod.rs:151-156), which widens explored interleavings beyond
+    # message-latency jitter. 0 (default) disables the draw's effect.
+    # Dynamic (lives in SimState.jitter): changing it needs no recompile.
+    op_jitter_max: int = 0
 
     def __post_init__(self):
         assert 0.0 <= self.packet_loss_rate <= 1.0, \
@@ -98,6 +105,7 @@ class NetConfig:
         assert 0 <= self.send_latency_min <= self.send_latency_max, \
             (f"inverted latency range {self.send_latency_min}.."
              f"{self.send_latency_max}")
+        assert self.op_jitter_max >= 0
 
     @staticmethod
     def from_toml(text: str) -> "NetConfig":
@@ -121,6 +129,8 @@ class NetConfig:
             kw["send_latency_min"] = int(data["send_latency_min"])
         if "send_latency_max" in data:
             kw["send_latency_max"] = int(data["send_latency_max"])
+        if "op_jitter_max" in data:  # ticks or a "5us"-style duration
+            kw["op_jitter_max"] = _parse_dur(str(data["op_jitter_max"]))
         return NetConfig(**kw)
 
 

@@ -54,7 +54,8 @@ def apply_net_override(state, net):
     return state.replace(
         loss=jnp.full_like(state.loss, net.packet_loss_rate),
         lat_lo=jnp.full_like(state.lat_lo, net.send_latency_min),
-        lat_hi=jnp.full_like(state.lat_hi, net.send_latency_max))
+        lat_hi=jnp.full_like(state.lat_hi, net.send_latency_max),
+        jitter=jnp.full_like(state.jitter, net.op_jitter_max))
 
 
 def env_net_override():

@@ -16,7 +16,7 @@ Message schema (payload words):
   RV : [term, last_log_len, last_log_term]          RequestVote
   RVR: [term, granted]                               RequestVote reply
   AE : [term, prev_len, prev_term, leader_commit,    AppendEntries
-        entry_term, entry_cmd, has_entry]            (one entry per message)
+        n_entries, k x (entry_term, entry_cmd)]      (k = ae_batch entries)
   AER: [term, success, match_len]                    AppendEntries reply
 """
 
@@ -152,7 +152,8 @@ class Raft(Program):
                  majority_override: int | None = None,
                  n_peers: int | None = None,
                  peer_base: int = 0,
-                 compact_threshold: int = 0):
+                 compact_threshold: int = 0,
+                 ae_batch: int = 1):
         self.n = n_nodes
         # raft peers occupy nodes [peer_base, peer_base + n_peers); the rest
         # of the cluster (KV clients, other raft groups in a multi-group
@@ -177,6 +178,14 @@ class Raft(Program):
         # past this many entries, fold it into the snapshot and slide the
         # window. 0 disables (logs must then fit log_capacity forever).
         self.compact_threshold = compact_threshold
+        # entries carried per AppendEntries (static: payload width is
+        # 5 + ae_batch*(1 + len(ENTRY_FIELDS)) words). 1 serializes log
+        # catch-up through one event-table row per entry; k batches the
+        # replication stream k entries per delivery, cutting the AE
+        # round-trips a lagging follower needs by ~k (measured delta in
+        # DESIGN §5).
+        assert ae_batch >= 1
+        self.ae_batch = ae_batch
         self._powP = _pow_table(log_capacity)
 
     ENTRY_FIELDS = ("cmd",)
@@ -212,7 +221,7 @@ class Raft(Program):
     def _is_extra_words(self, ctx, st):
         """Hook: extra InstallSnapshot payload words after the 4-word header
         (RaftKv ships chunked state-machine images here). Width must not
-        exceed 2 + len(ENTRY_FIELDS)."""
+        exceed 1 + ae_batch * (1 + len(ENTRY_FIELDS))."""
         return []
 
     def _install_ready(self, ctx, st, want, payload):
@@ -325,22 +334,23 @@ class Raft(Program):
         self._arm_election(ctx, st, is_el)  # candidate retries on split vote
 
         # heartbeat / replication tick (leader only). AE payload layout:
-        # [term, prev_len, prev_term, leader_commit, entry_term,
-        #  *ENTRY_FIELDS, has_entry]
+        # [term, prev_len, prev_term, leader_commit, n_entries,
+        #  ae_batch x (entry_term, *ENTRY_FIELDS)]
         is_hb = ((tag == T_HEARTBEAT) & (payload[0] == st["hgen"])
                  & (st["role"] == LEADER))
         # election RV, heartbeat AE, and snapshot IS are mutually exclusive
         # per peer, so they SHARE send slots — per-peer emission count (the
         # dominant per-step engine cost) is npeers, not 3*npeers
+        K, F = self.ae_batch, len(self.ENTRY_FIELDS)
         zero = jnp.zeros_like(st["term"])
         sl = st["snap_len"]
         rv_payload = jnp.stack(
             [st["term"], st["log_len"], last_t]
-            + [zero] * (3 + len(self.ENTRY_FIELDS)))
+            + [zero] * (2 + K * (1 + F)))
         # InstallSnapshot (§7): a follower whose next entry was compacted
         # away can't be caught up by AE — ship the snapshot summary instead
         extra = self._is_extra_words(ctx, st)
-        pad = 2 + len(self.ENTRY_FIELDS) - len(extra)
+        pad = 1 + K * (1 + F) - len(extra)
         assert pad >= 0, "IS extra words exceed the shared payload width"
         is_payload = jnp.stack(
             [st["term"], sl, st["snap_term"], st["snap_digest"]]
@@ -348,18 +358,20 @@ class Raft(Program):
         for p in range(self.base, self.base + self.npeers):
             nxt = st["next_idx"][p]
             need_is = nxt < sl
-            has = nxt < st["log_len"]
             prev_term = jnp.where(
                 nxt > sl,
                 take1(st["log_term"], jnp.clip(nxt - 1 - sl, 0, L - 1)),
                 st["snap_term"])
-            eidx = jnp.clip(nxt - sl, 0, L - 1)
+            cnt = jnp.clip(st["log_len"] - nxt, 0, K)
+            entry_words = []
+            for j in range(K):
+                eidx = jnp.clip(nxt + j - sl, 0, L - 1)
+                entry_words.append(take1(st["log_term"], eidx))
+                entry_words += [take1(st[f"log_{f}"], eidx)
+                                for f in self.ENTRY_FIELDS]
             ae_payload = jnp.stack(
-                [st["term"], nxt, prev_term, st["commit"],
-                 take1(st["log_term"], eidx)]
-                + [take1(st[f"log_{f}"], eidx)
-                   for f in self.ENTRY_FIELDS]
-                + [has.astype(jnp.int32)])
+                [st["term"], nxt, prev_term, st["commit"], cnt]
+                + entry_words)
             ctx.send(p,
                      jnp.where(is_el, RV, jnp.where(need_is, IS, AE)),
                      jnp.where(is_el, rv_payload,
@@ -424,14 +436,11 @@ class Raft(Program):
         self._on_become_leader(ctx, st, become_leader)
 
         # ---- AppendEntries (§5.3) ---------------------------------------
-        F = len(self.ENTRY_FIELDS)
+        K, F = self.ae_batch, len(self.ENTRY_FIELDS)
         is_ae = tag == AE
         is_is = tag == IS
         prev, prev_t = payload[1], payload[2]
-        lcommit, e_term = payload[3], payload[4]
-        e_fields = {f: payload[5 + i]
-                    for i, f in enumerate(self.ENTRY_FIELDS)}
-        has = payload[5 + F] == 1
+        lcommit, cnt_in = payload[3], payload[4]
         from_leader = (is_ae | is_is) & (term_in == st["term"])
         # a candidate discovering the elected leader returns to follower
         st["role"] = jnp.where(from_leader & (st["role"] == CANDIDATE),
@@ -445,23 +454,41 @@ class Raft(Program):
             & (take1(st["log_term"],
                      jnp.clip(prev - 1 - sl, 0, L - 1)) == prev_t))
         ok = (is_ae & (term_in == st["term"])) & prev_ok & (
-            ~has | (prev - sl < L))
-        write = ok & has & (prev >= sl)  # can't write below the snapshot
-        conflict = write & (prev < st["log_len"]) & (
-            take1(st["log_term"], jnp.clip(prev - sl, 0, L - 1)) != e_term)
-        widx = jnp.clip(prev - sl, 0, L - 1)
-        st["log_term"] = put_row(st["log_term"], widx, e_term, write)
-        for f in self.ENTRY_FIELDS:
-            st[f"log_{f}"] = put_row(st[f"log_{f}"], widx, e_fields[f],
-                                     write)
-        new_len = jnp.where(
-            write, jnp.where(conflict, prev + 1,
-                             jnp.maximum(st["log_len"], prev + 1)),
-            st["log_len"])
+            (cnt_in == 0) | (prev - sl < L))
+        # accept the batched entries in order (static unroll over K).
+        # cur_len threads the §5.3 conflict-truncation through the batch:
+        # a term mismatch at slot prev+j truncates the suffix to prev+j+1,
+        # later entries of the SAME batch then extend it again.
+        cur_len = st["log_len"]
+        n_acc = jnp.zeros_like(st["log_len"])
+        for j in range(K):
+            e_term_j = payload[5 + j * (1 + F)]
+            absn = prev + j
+            # covered: inside the window (entries below the snapshot are
+            # already covered by it — they count toward match but are
+            # never written)
+            covered_j = ok & (j < cnt_in) & (absn - sl < L)
+            valid_j = covered_j & (absn >= sl)
+            widx = jnp.clip(absn - sl, 0, L - 1)
+            conflict_j = valid_j & (absn < cur_len) & (
+                take1(st["log_term"], widx) != e_term_j)
+            st["log_term"] = put_row(st["log_term"], widx, e_term_j,
+                                     valid_j)
+            for i, f in enumerate(self.ENTRY_FIELDS):
+                st[f"log_{f}"] = put_row(st[f"log_{f}"], widx,
+                                         payload[6 + j * (1 + F) + i],
+                                         valid_j)
+            cur_len = jnp.where(
+                valid_j,
+                jnp.where(conflict_j, absn + 1,
+                          jnp.maximum(cur_len, absn + 1)),
+                cur_len)
+            n_acc = n_acc + covered_j
+        new_len = cur_len
         st["log_len"] = new_len
-        # an entry below the snapshot is already covered: report the
-        # snapshot boundary as matched so the leader's next_idx advances
-        match = jnp.where(ok, jnp.maximum(sl, prev + write), 0)
+        # match reports the contiguous covered prefix (snapshot floor +
+        # accepted batch) so the leader's next_idx advances
+        match = jnp.where(ok, jnp.maximum(sl, prev + n_acc), 0)
         st["commit"] = jnp.where(
             ok, jnp.maximum(st["commit"], jnp.minimum(lcommit, new_len)),
             st["commit"])

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import stats
+
 
 def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             dry_rounds: int = 2, base_seed: int = 0, chunk: int = 512):
@@ -45,7 +47,7 @@ def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         seeds = np.arange(base_seed + r * batch, base_seed + (r + 1) * batch,
                           dtype=np.uint32)
         state, _ = rt.run(rt.init_batch(seeds), max_steps, chunk)
-        hashes = np.asarray(state.sched_hash).tolist()
+        hashes = stats.sched_hash_u64(state).tolist()
         crashed = np.asarray(state.crashed)
         codes = np.asarray(state.crash_code)
         for i in np.nonzero(crashed)[0]:

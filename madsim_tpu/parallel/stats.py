@@ -12,15 +12,27 @@ from __future__ import annotations
 import numpy as np
 
 
-def schedule_representatives(state, seeds=None) -> dict:
+def sched_hash_u64(state) -> np.ndarray:
+    """Combine the two uint32 sched_hash lanes into one uint64 per
+    trajectory (see core/state.py — two lanes keep birthday collisions
+    negligible at 100k-seed fuzz scale)."""
+    h = np.asarray(state.sched_hash).astype(np.uint64)
+    return (h[..., 0] << np.uint64(32)) | h[..., 1]
+
+
+def schedule_representatives(state, seeds) -> dict:
     """{sched_hash: first seed that produced it} — one replayable
     representative per distinct interleaving class. After a sweep, replay
     just these with `Runtime.run_single` to see every distinct behavior
     the batch explored instead of eyeballing thousands of near-duplicate
-    trajectories."""
-    hashes = np.asarray(state.sched_hash)
-    seeds = (np.asarray(seeds) if seeds is not None
-             else np.arange(hashes.shape[0]))
+    trajectories.
+
+    `seeds` is required: it must be the exact seed array the batch was
+    initialized with. Defaulting to arange(batch) would silently label
+    lane indices as seeds after a sweep over non-contiguous seeds —
+    non-replayable handles."""
+    hashes = sched_hash_u64(state)
+    seeds = np.asarray(seeds)
     # return_index gives first-occurrence indices: first seed wins
     uniq, idx = np.unique(hashes, return_index=True)
     return dict(zip(uniq.tolist(), seeds[idx].tolist()))
@@ -71,7 +83,6 @@ def summarize(rt, state, seeds=None) -> dict:
         # plus all payload/state differences) but it answers the coverage
         # question directly: how many INTERLEAVINGS did the batch explore,
         # independent of what values flowed through them.
-        distinct_schedules=int(
-            len(np.unique(np.asarray(state.sched_hash)))),
+        distinct_schedules=int(len(np.unique(sched_hash_u64(state)))),
         oops=int((np.asarray(state.oops) != 0).sum()),
     )

@@ -6,6 +6,7 @@ import numpy as np
 
 from madsim_tpu import (Program, Runtime, Scenario, SimConfig, NetConfig,
                         ms, sec)
+from madsim_tpu.core import types as T
 from madsim_tpu.models.pingpong import PingPong, state_spec
 
 
@@ -95,6 +96,34 @@ class TestEdges:
                      state_spec())
         state, _ = rt.run(rt.init_single(0), 4000)
         assert bool(state.halted.all()) and not bool(state.crashed.any())
+
+
+class SlowTicker(Program):
+    """One timer every 30 simulated seconds, forever — walks the virtual
+    clock to the int32 tick cap in ~72 events."""
+
+    def init(self, ctx):
+        ctx.set_timer(sec(30), 1)
+
+    def on_timer(self, ctx, tag, payload):
+        ctx.set_timer(sec(30), 1)
+
+
+class TestTickCap:
+    def test_time_overflow_oopses_instead_of_wrapping(self):
+        # the documented ~35-min ceiling (types.py: int32 ticks): driving
+        # a trajectory to the cap must set OOPS_TIME_OVERFLOW — red if
+        # the guard in step.py §4 is removed — and the clock must never
+        # wrap negative (deadlines that overflow fire "now", monotone)
+        cfg = SimConfig(n_nodes=1, time_limit=int(T.T_INF) - 1)
+        rt = Runtime(cfg, [SlowTicker()],
+                     dict(x=jnp.asarray(0, jnp.int32)))
+        state, _ = rt.run(rt.init_batch(np.arange(4)), 200)
+        oops = np.asarray(state.oops)
+        now = np.asarray(state.now)
+        assert (oops & T.OOPS_TIME_OVERFLOW != 0).all()
+        assert (now >= 0).all() and (now <= T.T_INF).all()
+        assert not bool(np.asarray(state.crashed).any())
 
 
 class TestStatsFlag:

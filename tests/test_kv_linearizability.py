@@ -92,6 +92,30 @@ class TestKvFuzz:
         for h in hists:
             assert check_kv_history(h)
 
+    def test_python_fallback_beyond_57_ops(self, monkeypatch):
+        # the native checker splits at 57 ops/key (linearize.cpp memo-key
+        # width); a REAL fuzz producing a >57-op single-key history must
+        # flow through the Python fallback end-to-end and still verdict
+        from madsim_tpu import native
+        assert native._load() is not None  # the native path exists...
+        calls = {"py": 0}
+        orig = native._check_register_py
+
+        def counting(*a):
+            calls["py"] += 1
+            return orig(*a)
+        monkeypatch.setattr(native, "_check_register_py", counting)
+        # 3 clients x 20 ops on ONE key = 60 ops > 57
+        rt = make_kv_runtime(n_raft=3, n_clients=3, n_keys=1, n_ops=20,
+                             log_capacity=96)
+        state = run_seeds(rt, np.arange(4), max_steps=60_000)
+        hists = extract_histories(state, 3, 3)
+        big = [h for h in hists if len(h["op"]) > 57]
+        assert big, "fuzz failed to produce a >57-op history"
+        for h in hists:
+            assert check_kv_history(h)
+        assert calls["py"] > 0  # ...but the >57 histories took the fallback
+
     def test_detector_catches_corruption(self):
         # mutate one observed GET: the checker must reject the history
         rt = make_kv_runtime(n_raft=3, n_clients=2, n_keys=1, n_ops=6,

@@ -51,6 +51,23 @@ class TestMiniStream:
             run_seeds(rt, np.arange(32), max_steps=60_000)
         assert ei.value.code == msv.CRASH_STREAM_LOST_OR_DUP
 
+    def test_k_at_bitmask_ceiling(self):
+        # K=31 fills every bit of the one-word idx bitmask (the documented
+        # capacity edge, ministream.py): exactly-once must hold AT the
+        # ceiling under mapper chaos, and K=32 must be rejected, not wrap
+        from madsim_tpu.core.types import NetConfig, SimConfig, sec
+        with pytest.raises(AssertionError):
+            make_ministream_runtime(k=32, epochs=2)
+        sc = Scenario()
+        sc.at(ms(300)).kill_random(among=(msv.MAP_A, msv.MAP_B))
+        sc.at(ms(700)).restart_random(among=(msv.MAP_A, msv.MAP_B))
+        cfg = SimConfig(n_nodes=4, event_capacity=320, time_limit=sec(60),
+                        net=NetConfig(packet_loss_rate=0.05))
+        rt = make_ministream_runtime(k=31, epochs=2, scenario=sc, cfg=cfg)
+        state = run_seeds(rt, np.arange(16), max_steps=80_000)
+        assert (np.asarray(state.oops) == 0).all()
+        assert (_committed(state) == 2).all()
+
     def test_replay_stable(self):
         sc = Scenario()
         sc.at(ms(400)).kill_random(among=(msv.MAP_A, msv.MAP_B))

@@ -80,6 +80,32 @@ class TestCompaction:
         assert (snap[:, 0] > 0).all()
         assert (commit[:, 0] >= CMDS - L).all()
 
+    def test_window_full_at_threshold_under_chaos(self):
+        # capacity edge: compact_threshold == log_capacity, so the window
+        # must fill COMPLETELY (live == L, where _append starts dropping
+        # proposals) before a slide becomes possible at all — progress
+        # then depends on the full-window compact firing exactly at the
+        # boundary. Red if the `live < L` append guard or the
+        # shift >= threshold compare is off by one.
+        sc = Scenario()
+        sc.at(ms(900)).kill_random()
+        sc.at(ms(1400)).restart_random()
+        rt = _rt(scenario=sc, halt_on_commit=2 * L + 2,
+                 time_limit=sec(12), compact_threshold=L)
+        state = run_seeds(rt, SEEDS, max_steps=40_000)
+        ns = state.node_state
+        commit = np.asarray(ns["commit"])
+        snap = np.asarray(ns["snap_len"])
+        loglen = np.asarray(ns["log_len"])
+        # committed past two full windows -> at least one full-window slide
+        assert (commit.max(axis=1) >= 2 * L + 2).all()
+        assert (snap.max(axis=1) >= L).all()
+        # slides are exact multiples of nothing less than the threshold:
+        # every snapshot boundary is >= L entries deep or still zero
+        assert ((snap == 0) | (snap >= L)).all()
+        assert (loglen - snap <= L).all()
+        assert (np.asarray(state.oops) == 0).all()
+
     def test_chaos_with_compaction_safety(self):
         # rolling kills/restarts + a partition while the window wraps:
         # the per-event invariant (incl. digest chain) must hold throughout

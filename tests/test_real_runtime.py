@@ -177,11 +177,91 @@ class TestCompiledDispatch:
 
 
 @pytest.mark.realworld
+class TestBatchedDrain:
+    """batch_drain=K: events queue and run through ONE jitted scan per
+    drain (real/runtime.py _drain) — the amortized-dispatch mode. Same
+    Programs, same effects contract; these tests pin the semantics the
+    batching must not change."""
+
+    def test_echo_fanout_batched(self):
+        cfg = SimConfig(n_nodes=4, time_limit=sec(30))
+        rt = RealRuntime(cfg, [EchoServer(), EchoClient(target=5,
+                                                        timeout=ms(150))],
+                         server_state_spec(), node_prog=[0, 1, 1, 1],
+                         base_port=19730, batch_drain=8)
+        rt.run(duration=20.0)
+        assert not rt.crashed
+        acked = [int(s["acked"]) for s in rt.states()[1:]]
+        assert all(a >= 5 for a in acked), acked
+        assert int(rt.states()[0]["served"]) >= 15
+
+    def test_kill_restart_batched(self):
+        # drain-time liveness: events queued for a node killed between
+        # enqueue and drain are dropped; restart invalidates the stacked
+        # cache so the fresh state is what later drains see
+        import asyncio
+
+        n = 2
+        cfg = SimConfig(n_nodes=n, time_limit=sec(10))
+        rt = RealRuntime(cfg, [PingPong(n, target=8, retry=ms(30))],
+                         state_spec(), base_port=19750, batch_drain=8)
+
+        async def scenario():
+            await rt.start()
+            await asyncio.sleep(0.2)
+            rt.kill(1)
+            await asyncio.sleep(0.3)
+            await rt.restart(1)
+            try:
+                await asyncio.wait_for(rt._halted.wait(), timeout=6.0)
+            except asyncio.TimeoutError:
+                pass
+            for i in range(n):
+                rt.kill(i)
+
+        asyncio.run(scenario())
+        assert not rt.crashed
+        assert int(rt.states()[0]["acked"]) >= 8
+
+    def test_kill_purges_queued_events(self):
+        # a killed process's pending events must never fire: events
+        # already enqueued for the drain are purged by kill(), so a
+        # kill+restart inside the coalescing window can't replay
+        # old-incarnation events against the recovered state
+        import jax.numpy as jnp
+
+        cfg = SimConfig(n_nodes=2, time_limit=sec(5))
+        rt = RealRuntime(cfg, [PingPong(2, target=1, retry=ms(30))],
+                         state_spec(), base_port=19790, batch_drain=4)
+        rt.nodes[0].alive = rt.nodes[1].alive = True
+        z = jnp.zeros((cfg.payload_words,), jnp.int32)
+        rt._queue.append((1, 2, 0, 1, z))
+        rt._queue.append((0, 2, 0, 1, z))
+        rt.kill(1)
+        assert [ev[0] for ev in rt._queue] == [0]
+
+    def test_coalescing_delay_still_completes(self):
+        cfg = SimConfig(n_nodes=3, time_limit=sec(30))
+        rt = RealRuntime(cfg, [EchoServer(), EchoClient(target=5,
+                                                        timeout=ms(150))],
+                         server_state_spec(), node_prog=[0, 1, 1],
+                         base_port=19770, batch_drain=16)
+        rt.drain_delay = 0.002   # trade per-hop latency for drain depth
+        rt.run(duration=20.0)
+        assert not rt.crashed
+        acked = [int(s["acked"]) for s in rt.states()[1:]]
+        assert all(a >= 5 for a in acked), acked
+
+
+@pytest.mark.realworld
 class TestRealCancelTimer:
-    @pytest.mark.parametrize("compiled", [False, True])
-    def test_cancel_really_cancels_wall_clock_timer(self, compiled):
+    @pytest.mark.parametrize("compiled,batch", [(False, 0), (True, 0),
+                                                (True, 8)])
+    def test_cancel_really_cancels_wall_clock_timer(self, compiled, batch):
         # dual-world parity for ctx.cancel_timer: the asyncio timer is
-        # genuinely cancelled, red/green via the do_cancel knob
+        # genuinely cancelled, red/green via the do_cancel knob — in all
+        # three dispatch modes (eager / per-event compiled / batched
+        # drain, whose cancels apply host-side after the drain)
         import jax.numpy as jnp
 
         from madsim_tpu.core.api import Program
@@ -207,7 +287,8 @@ class TestRealCancelTimer:
             cfg = SimConfig(n_nodes=1, time_limit=sec(5))
             rt = RealRuntime(cfg, [CancelDemo(do_cancel)],
                              dict(fired=jnp.asarray(0, jnp.int32)),
-                             base_port=19680, compiled=compiled)
+                             base_port=19680, compiled=compiled,
+                             batch_drain=batch)
             # compile warmup happens in start() BEFORE the duration
             # window opens, so both modes fit the same budget
             rt.run(duration=1.0)
