@@ -27,15 +27,23 @@ STEPS = 1024        # timed steps
 CPU_STEPS = 512     # timed steps for the single-seed CPU baseline
 
 
-def _make_runtime(scheduler: str = "reference", table_dtype: str = "int32"):
+def _make_runtime(scheduler: str = "reference", table_dtype: str = "int32",
+                  n_nodes: int = 5, log_capacity: int = 32,
+                  payload_words: int = 8, event_capacity: int | None = None):
     from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
     from madsim_tpu.models.raft import make_raft_runtime
 
-    n = 5
-    # event_capacity sized from measured occupancy (peak 75 rows over
-    # 4096-step chaos runs; state.ev_peak tracks this) — [batch, capacity]
-    # ops dominate the step, so a tight table is a direct speedup
-    cfg = SimConfig(n_nodes=n, event_capacity=96, time_limit=sec(600),
+    n = n_nodes
+    # event_capacity sized from measured occupancy (state.ev_peak): n=5
+    # peaks at 75 rows, n=15 at 135, n=25 at 216 over 4096-step chaos
+    # runs — ~9n, linear because randomized election timeouts stagger RV
+    # broadcasts (the O(n^2) simultaneous-candidates storm doesn't
+    # materialize). 16n gives ~1.8x headroom; the bench's oops assert
+    # turns any overflow into a loud failure, not UB.
+    if event_capacity is None:
+        event_capacity = max(96, 16 * n)
+    cfg = SimConfig(n_nodes=n, event_capacity=event_capacity,
+                    time_limit=sec(600), payload_words=payload_words,
                     net=NetConfig(packet_loss_rate=0.05),
                     scheduler=scheduler, table_dtype=table_dtype)
     sc = Scenario()
@@ -44,8 +52,8 @@ def _make_runtime(scheduler: str = "reference", table_dtype: str = "int32"):
         sc.at(sec(1 + t) + ms(400)).restart_random()
         sc.at(sec(1 + t) + ms(600)).partition([t % n, (t + 1) % n])
         sc.at(sec(1 + t) + ms(900)).heal()
-    return make_raft_runtime(n, log_capacity=32, n_cmds=24, scenario=sc,
-                             cfg=cfg)
+    return make_raft_runtime(n, log_capacity=log_capacity, n_cmds=24,
+                             scenario=sc, cfg=cfg)
 
 
 def _events_per_sec(batch: int, steps: int, warm: int, make=None) -> float:
@@ -502,9 +510,47 @@ def _scaling_mode():
         "batch": B, "rows": rows}))
 
 
+def _shape_sweep_mode():
+    """--shape-sweep: throughput vs workload shape on the flagship Raft
+    chaos fuzz — one axis varied at a time from the base shape (n=5,
+    L=32, P=8, C=96). This measures where DESIGN §5's [batch, C(,P)]
+    bandwidth wall and the per-peer emission count (a Raft heartbeat
+    stages npeers send slots EVERY step) actually bite."""
+    import jax
+    platform = jax.devices()[0].platform
+    big = platform != "cpu"
+    B = B_TPU if big else 512
+    steps = STEPS if big else 256
+    warm = WARM if big else 64
+    points = ([("base", {})]
+              + [(f"n_nodes={n}", {"n_nodes": n}) for n in (15, 25, 64)]
+              + [(f"log_capacity={L}", {"log_capacity": L})
+                 for L in (16, 64)]
+              + [(f"payload_words={P}", {"payload_words": P})
+                 for P in (16,)])
+    out = {"metric": "shape_sweep", "platform": platform, "batch": B,
+           "base": {"n_nodes": 5, "log_capacity": 32, "payload_words": 8,
+                    "event_capacity": 96},
+           "points": {}}
+    for name, kw in points:
+        try:
+            eps = _events_per_sec(B, steps, warm,
+                                  make=lambda: _make_runtime(**kw))
+            out["points"][name] = round(eps, 1)
+            print(f"--shape-sweep: {name} {eps:,.0f} seed-events/s",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - partial evidence > none
+            out["points"][name] = f"{type(e).__name__}: {e}"
+            print(f"--shape-sweep: {name} FAILED {e!r}", file=sys.stderr)
+    print(json.dumps(out))
+
+
 def main():
     if "--multihost" in sys.argv:
         _multihost_mode()
+        return
+    if "--shape-sweep" in sys.argv:
+        _shape_sweep_mode()
         return
     if "--sweep" in sys.argv:
         _sweep_mode()

@@ -278,12 +278,24 @@ def make_step(
             free = s.t_kind == T.EV_FREE
             occupied_now = (~free).sum(dtype=jnp.int32)
             slots, slot_ok = sel.first_k_free(free, E)
-            # per-send: loss + latency keys; per-emission (send AND timer):
-            # one micro-jitter key (net/mod.rs:151-156 — the reference
-            # random-delays EVERY network op; with op_jitter_max == 0 the
-            # draw is constant 0 and XLA folds it away)
-            net_keys = prng.split(k_net, 2 * max(n_sends, 1) + E)
+            # per-send: loss + latency keys; per-emission (send AND
+            # timer): one micro-jitter key (net/mod.rs:151-156 — the
+            # reference random-delays EVERY network op). STATICALLY
+            # gated: the draws cost a key-split + randint per emission
+            # on the dominant phase, so a build with op_jitter_max == 0
+            # compiles none of it; when enabled, the BOUND (state.
+            # jitter) stays dynamic and tunes without recompile.
+            # Enabled/disabled are distinct replay domains (the config
+            # hash covers the field); apply_net_override refuses to set
+            # a nonzero bound on a jitterless build.
+            use_jitter = cfg.net.op_jitter_max > 0
+            net_keys = prng.split(
+                k_net, 2 * max(n_sends, 1) + (E if use_jitter else 0))
             jit_keys = net_keys[2 * max(n_sends, 1):]
+
+            def jitter_draw(key):
+                return (prng.randint(key, 0, s.jitter) if use_jitter
+                        else jnp.asarray(0, jnp.int32))
             em_write, em_deadline, em_kind = [], [], []
             em_node, em_tag, em_payload = [], [], []
             src_clog = sel.take1(s.clog_node, h_node)
@@ -298,7 +310,8 @@ def make_step(
                 lost = prng.bernoulli(net_keys[2 * j], s.loss)
                 latency = (prng.randint(net_keys[2 * j + 1], s.lat_lo,
                                         s.lat_hi)
-                           + prng.randint(jit_keys[j], 0, s.jitter))
+                           + jitter_draw(jit_keys[j] if use_jitter
+                                         else None))
                 ok = e["m"] & ~clogged & ~lost
                 sent = sent + e["m"].astype(jnp.int32)
                 delivered_drop = delivered_drop + (e["m"] & ~ok).astype(
@@ -317,8 +330,9 @@ def make_step(
                 overflow = overflow | (e["m"] & ~slot_ok[n_sends + j])
                 em_write.append(write)
                 em_deadline.append(s.now + e["delay"]
-                                   + prng.randint(jit_keys[n_sends + j],
-                                                  0, s.jitter))
+                                   + jitter_draw(
+                                       jit_keys[n_sends + j]
+                                       if use_jitter else None))
                 em_kind.append(jnp.asarray(T.EV_TIMER, jnp.int32))
                 em_node.append(h_node)
                 em_tag.append(e["tag"])

@@ -95,8 +95,12 @@ class NetConfig:
     # send's latency draw AND every timer's deadline — the analog of the
     # reference's random 0-5 us delay before each network op
     # (net/mod.rs:151-156), which widens explored interleavings beyond
-    # message-latency jitter. 0 (default) disables the draw's effect.
-    # Dynamic (lives in SimState.jitter): changing it needs no recompile.
+    # message-latency jitter. STATIC gate, dynamic bound: 0 (default)
+    # compiles the fold out entirely (zero extra draws on the emission
+    # phase); > 0 compiles it in, and the bound then lives in
+    # SimState.jitter where set-ops/overrides can tune it without
+    # recompile. Enabled/disabled builds are distinct replay domains
+    # (the config hash covers this field).
     op_jitter_max: int = 0
 
     def __post_init__(self):

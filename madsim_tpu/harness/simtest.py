@@ -43,14 +43,25 @@ def _env_int(name, default):
     return int(v) if v else default
 
 
-def apply_net_override(state, net):
+def apply_net_override(state, net, cfg=None):
     """Apply a NetConfig onto a (batched) state's DYNAMIC network knobs —
     loss and latency live in state, so MADSIM_TEST_CONFIG can reshape the
     fault model without recompiling (the TOML-injection contract of
-    macros lib.rs:146-151)."""
+    macros lib.rs:146-151).
+
+    op_jitter_max's BOUND is dynamic too, but the jitter fold itself is
+    compiled in only when the build's SimConfig enabled it (step.py §4:
+    a jitterless build pays zero draws) — pass `cfg` to catch the
+    silent no-op of overriding jitter onto a jitterless build."""
     import jax.numpy as jnp
     if net is None:
         return state
+    if cfg is not None and net.op_jitter_max > 0 \
+            and cfg.net.op_jitter_max == 0:
+        raise ValueError(
+            "op_jitter_max override needs a build with jitter enabled: "
+            "construct SimConfig(net=NetConfig(op_jitter_max>0)) — the "
+            "fold is static (step.py §4); only its bound is dynamic")
     return state.replace(
         loss=jnp.full_like(state.loss, net.packet_loss_rate),
         lat_lo=jnp.full_like(state.lat_lo, net.send_latency_min),
@@ -85,7 +96,7 @@ def run_seeds(rt: Runtime, seeds, max_steps: int, chunk: int = 512,
     """Run a seed batch to completion; raise SimFailure on the first crashed
     seed (lowest index). Returns the final batched state."""
     init = apply_net_override(rt.init_batch(np.asarray(seeds, np.uint32)),
-                              net_override)
+                              net_override, cfg=rt.cfg)
     if time_limit_override:
         init = rt.set_time_limit(init, time_limit_override)
     cfg_hash = effective_config_hash(rt, net_override, time_limit_override)

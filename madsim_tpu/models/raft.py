@@ -489,8 +489,13 @@ class Raft(Program):
         # match reports the contiguous covered prefix (snapshot floor +
         # accepted batch) so the leader's next_idx advances
         match = jnp.where(ok, jnp.maximum(sl, prev + n_acc), 0)
+        # commit = min(leaderCommit, index of last VERIFIED entry) —
+        # Figure 2's "last new entry", which here is `match`, NOT the
+        # follower's log length: an uncommitted stale suffix beyond the
+        # verified prefix must not be committed just because
+        # leaderCommit is numerically past it (State Machine Safety)
         st["commit"] = jnp.where(
-            ok, jnp.maximum(st["commit"], jnp.minimum(lcommit, new_len)),
+            ok, jnp.maximum(st["commit"], jnp.minimum(lcommit, match)),
             st["commit"])
 
         # ---- InstallSnapshot (§7, follower side) ------------------------

@@ -633,6 +633,17 @@ class RealRuntime:
             n.timers = [(tg, h) for tg, h in n.timers if tg != t]
             n.parked = [(kind, args) for kind, args in n.parked
                         if not (kind == "timer" and int(args[0]) == t)]
+            # batched mode: also purge matching timer firings already
+            # sitting in the drain queue (a handle that fired during the
+            # coalescing window), mirroring per-event semantics where
+            # the cancel lands before the call_later fires. Events of
+            # the SAME drain are inherently concurrent — a cancel
+            # cannot retract a firing that ran earlier in its own scan;
+            # the call-id payload idiom covers that residual window.
+            if self.batch_drain:
+                self._queue = [ev for ev in self._queue
+                               if not (ev[0] == n.id and ev[1] == 2
+                                       and int(ev[3]) == t)]
         for e in ctx._timers:
             if not bool(e["m"]):
                 continue

@@ -384,7 +384,8 @@ class Runtime:
         from ..harness.simtest import apply_net_override
 
         def once():
-            s = apply_net_override(self.init_single(seed), net_override)
+            s = apply_net_override(self.init_single(seed), net_override,
+                                   cfg=self.cfg)
             s, _ = self.run(s, max_steps, collect_events=False)
             return s
 

@@ -14,7 +14,8 @@ for i in $(seq 1 200); do
     # survive as commits instead of dying with the stuck watcher
     for pair in ":BENCH_tpu.json" "--all:BENCH_tpu_all.json" \
                 "--sched-ab:BENCH_tpu_sched_ab.json" \
-                "--sweep:BENCH_tpu_sweep.json"; do
+                "--sweep:BENCH_tpu_sweep.json" \
+                "--shape-sweep:BENCH_tpu_shape_sweep.json"; do
       mode="${pair%%:*}"; out="${pair#*:}"
       echo "$(date -u +%H:%M:%S) running bench $mode -> $out" >> tpu_watch.log
       python bench.py $mode > "$out" 2>> tpu_watch.log

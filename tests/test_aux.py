@@ -217,9 +217,17 @@ class TestOpJitter:
         from madsim_tpu.harness.simtest import apply_net_override
         net = NetConfig.from_toml('[net]\nop_jitter_max = "5us"\n')
         assert net.op_jitter_max == 5
-        rt = self._rt(0)
-        st = apply_net_override(rt.init_batch(np.arange(4)), net)
+        # bound override on an ENABLED build: dynamic, no recompile
+        rt = self._rt(1)
+        st = apply_net_override(rt.init_batch(np.arange(4)), net,
+                                cfg=rt.cfg)
         assert (np.asarray(st.jitter) == 5).all()
+        # jitter override on a jitterless build would be a silent no-op
+        # (the fold is compiled out) — must refuse loudly instead
+        rt0 = self._rt(0)
+        with pytest.raises(ValueError, match="jitter"):
+            apply_net_override(rt0.init_batch(np.arange(4)), net,
+                               cfg=rt0.cfg)
 
 
 class TestCompaction:

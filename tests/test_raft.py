@@ -145,6 +145,42 @@ class TestDeterminism:
         assert rt.check_determinism(seed=2024, max_steps=4000)
 
 
+class TestCommitClamp:
+    def test_leadercommit_clamps_to_verified_prefix(self):
+        # Figure 2: commit = min(leaderCommit, index of last NEW entry).
+        # "Last new entry" is the VERIFIED prefix (prev + accepted), not
+        # the follower's log length: a follower holding an uncommitted
+        # stale suffix must not commit it just because leaderCommit is
+        # numerically past it. Red if the commit rule clamps to new_len.
+        from madsim_tpu.core import prng
+        from madsim_tpu.core.api import Ctx
+
+        cfg = SimConfig(n_nodes=3, payload_words=8)
+        prog = R.Raft(3, log_capacity=8)
+        z = jnp.asarray(0, jnp.int32)
+        st = dict(
+            term=jnp.asarray(3, jnp.int32),
+            voted_for=jnp.asarray(-1, jnp.int32),
+            # entries 2..5 are a STALE term-2 suffix this leader never
+            # verified (its AE only proves the prefix up to prev=2)
+            log_term=jnp.asarray([1, 1, 2, 2, 2, 2, 0, 0], jnp.int32),
+            log_len=jnp.asarray(6, jnp.int32),
+            snap_len=z, snap_term=z, snap_digest=z,
+            role=z, votes=z, commit=jnp.asarray(2, jnp.int32),
+            next_idx=jnp.zeros(3, jnp.int32),
+            match_idx=jnp.zeros(3, jnp.int32),
+            egen=z, hgen=z, nprop=z,
+            log_cmd=jnp.zeros(8, jnp.int32),
+        )
+        ctx = Ctx(cfg, jnp.asarray(1, jnp.int32), z, prng.seed_key(0), st)
+        # heartbeat AE from the term-3 leader: prev=2 (term 1, matches),
+        # zero entries, leaderCommit=6
+        payload = jnp.asarray([3, 2, 1, 6, 0, 0, 0, 0], jnp.int32)
+        prog.on_message(ctx, jnp.asarray(0, jnp.int32),
+                        jnp.asarray(R.AE, jnp.int32), payload)
+        assert int(ctx.state["commit"]) == 2   # not 6
+
+
 class TestMultiEntryAE:
     """ae_batch > 1: k entries per AppendEntries (payload-packed, static k).
 

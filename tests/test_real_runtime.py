@@ -240,6 +240,27 @@ class TestBatchedDrain:
         rt.kill(1)
         assert [ev[0] for ev in rt._queue] == [0]
 
+    def test_cancel_purges_queued_timer_firings(self):
+        # per-event parity: a cancel must also retract a timer firing
+        # that landed in the drain queue during the coalescing window
+        # (in per-event mode the handle is cancelled before it fires);
+        # messages and other nodes' timers survive
+        import jax.numpy as jnp
+
+        from madsim_tpu.real.runtime import _Staged
+
+        cfg = SimConfig(n_nodes=2, time_limit=sec(5))
+        rt = RealRuntime(cfg, [PingPong(2, target=1, retry=ms(30))],
+                         state_spec(), base_port=19795, batch_drain=4)
+        z = jnp.zeros((cfg.payload_words,), jnp.int32)
+        rt._queue.append((0, 2, 0, 5, z))   # node 0 timer tag 5: purged
+        rt._queue.append((0, 1, 1, 5, z))   # node 0 MESSAGE: survives
+        rt._queue.append((1, 2, 0, 5, z))   # node 1 timer: survives
+        staged = _Staged(rt.nodes[0].state, [], [],
+                         [dict(m=True, tag=5)], False, 0, False)
+        rt._apply_effects(rt.nodes[0], staged)
+        assert [(ev[0], ev[1]) for ev in rt._queue] == [(0, 1), (1, 2)]
+
     def test_coalescing_delay_still_completes(self):
         cfg = SimConfig(n_nodes=3, time_limit=sec(30))
         rt = RealRuntime(cfg, [EchoServer(), EchoClient(target=5,
