@@ -528,10 +528,15 @@ def _shape_sweep_mode():
                  for L in (16, 64)]
               + [(f"payload_words={P}", {"payload_words": P})
                  for P in (16,)])
+    # report the ACTUAL base shape from the runtime under test, not a
+    # copy of its defaults that could drift
+    base_rt = _make_runtime()
+    base = dict(n_nodes=base_rt.cfg.n_nodes,
+                log_capacity=int(base_rt.programs[0].L),
+                payload_words=base_rt.cfg.payload_words,
+                event_capacity=base_rt.cfg.event_capacity)
     out = {"metric": "shape_sweep", "platform": platform, "batch": B,
-           "base": {"n_nodes": 5, "log_capacity": 32, "payload_words": 8,
-                    "event_capacity": 96},
-           "points": {}}
+           "base": base, "points": {}}
     for name, kw in points:
         try:
             eps = _events_per_sec(B, steps, warm,

@@ -389,15 +389,27 @@ class TestRealProcessDeath:
              data_dir, str(port), sync_flag, transport],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         last = [0, 0]
-        deadline = _time.monotonic() + 30
+        # generous: the child pays a full cold jax import (~20s when the
+        # suite runs cache-cold on this 1-core box) before any protocol
+        # traffic; 30s flaked exactly once under a cold `ci.sh full`.
+        # select-bounded: a child that wedges BEFORE printing anything
+        # (e.g. backend init) must time the test out, not hang it — a
+        # blocking `for line in stdout` would never reach a deadline
+        # check.
+        import select
+        deadline = _time.monotonic() + 120
         try:
-            for line in child.stdout:
+            while _time.monotonic() < deadline:
+                ready, _, _ = select.select([child.stdout], [], [], 1.0)
+                if not ready:
+                    continue
+                line = child.stdout.readline()
+                if not line:                     # EOF: child exited
+                    break
                 if line.startswith("ACKED"):
                     last = [int(v) for v in line.split()[1:]]
                     if min(last) >= min_acked:
                         break
-                if _time.monotonic() > deadline:
-                    break
         finally:
             child.send_signal(signal.SIGKILL)    # real power-fail
             child.wait()
