@@ -304,20 +304,27 @@ def _ministream_mode(emit=True):
     return out
 
 
+def _preflight_or_cpu(label: str) -> bool:
+    """Bounded TPU preflight (retry once), CPU fallback: an in-process
+    jax.devices() against a wedged tunnel blocks forever, before any
+    per-workload try/except could help — and the watcher runs these
+    modes with no timeout. One helper so every mode shares the same
+    policy; a mode that skips it hangs against a wedged tunnel.
+    Returns whether the chip answered."""
+    on_tpu = _tpu_alive() or _tpu_alive()
+    if not on_tpu:
+        print(f"{label}: tpu preflight failed; running batched CPU",
+              file=sys.stderr)
+        _force_cpu_inprocess()
+    return on_tpu
+
+
 def _all_mode():
     """--all: one combined JSON with every workload's batched number on
     the current default platform (flagship raft chaos, shardkv migration,
     minipg sessions, ministream barriers). One tunnel revival captures
     everything."""
-    # bounded preflight FIRST: an in-process jax.devices() against a
-    # wedged tunnel blocks forever, before the per-workload try/except
-    # could ever help — and the watcher runs --all with no timeout. If
-    # the chip is gone, fall back to CPU the same way main() does so the
-    # combined artifact still exists (and says so).
-    if not (_tpu_alive() or _tpu_alive()):
-        print("--all: tpu preflight failed; running batched CPU",
-              file=sys.stderr)
-        _force_cpu_inprocess()
+    _preflight_or_cpu("--all")
     import jax
     platform = jax.devices()[0].platform
     combined = {"metric": "bench_all", "platform": platform,
@@ -516,6 +523,7 @@ def _shape_sweep_mode():
     L=32, P=8, C=96). This measures where DESIGN §5's [batch, C(,P)]
     bandwidth wall and the per-peer emission count (a Raft heartbeat
     stages npeers send slots EVERY step) actually bite."""
+    _preflight_or_cpu("--shape-sweep")
     import jax
     platform = jax.devices()[0].platform
     big = platform != "cpu"
@@ -595,16 +603,9 @@ def main():
     print(f"cpu single-seed baseline: {cpu_eps:,.0f} events/s",
           file=sys.stderr)
 
-    # Preflight the chip (retry once: the tunnel sometimes needs a nudge).
-    on_tpu = _tpu_alive() or _tpu_alive()
-    if not on_tpu:
-        # No chip: fall back to batched-on-CPU so the round still records
-        # a real speedup number instead of a traceback (the fallback
-        # would otherwise hang on the same wedged tunnel the preflight
-        # just detected — see _force_cpu_inprocess).
-        print("tpu preflight failed; falling back to batched CPU",
-              file=sys.stderr)
-        _force_cpu_inprocess()
+    # No chip answering means batched-on-CPU, so the round still records
+    # a real speedup number instead of a traceback.
+    on_tpu = _preflight_or_cpu("bench")
 
     batched_eps = _batched_eps_with_retry("tpu" if on_tpu else "cpu")
 
