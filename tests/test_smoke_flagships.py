@@ -1,0 +1,93 @@
+"""Fast-tier smoke coverage of the flagship workloads.
+
+`ci.sh fast` deselects the slow suites (shard_kv, minipg, kv fuzz, bank,
+streaming, ministream) for iteration speed — which left the default
+green signal blind to the flagship stacks (VERDICT r3 weak #6). Each
+smoke here runs the SAME compiled program as its slow suite (identical
+SimConfig statics and batch size, so the persistent XLA cache is shared
+and no extra compile is paid) with a reduced step budget: deep enough
+that the full protocol stack executes and every per-event invariant is
+checked on every dispatched event, shallow enough for the fast tier.
+Completion-grade assertions stay in the slow suites; a crash or a
+capacity overflow anywhere in these stacks fails HERE, in the default
+tier.
+"""
+
+import numpy as np
+
+from madsim_tpu import NetConfig, SimConfig, ms, sec
+from madsim_tpu.harness.simtest import run_seeds
+from madsim_tpu.native import check_kv_history
+
+
+def _healthy(state):
+    # run_seeds already raised on any crash (per-event invariants
+    # included); overflow bits and basic traffic are the smoke floor
+    assert (np.asarray(state.oops) == 0).all()
+    assert int(np.asarray(state.msg_delivered).sum()) > 0
+
+
+class TestFlagshipSmoke:
+    def test_shard_kv_stack(self):
+        # statics mirror tests/test_shard_kv.py exactly (shared program)
+        from madsim_tpu.models.shard_kv import make_shard_runtime
+        cfg = SimConfig(n_nodes=3 + 2 * 3 + 2, event_capacity=160,
+                        payload_words=12, time_limit=sec(60),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(10)))
+        rt = make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2,
+                                n_ops=5, max_cfg=4, cfg=cfg)
+        state = run_seeds(rt, np.arange(12), max_steps=12_000)
+        _healthy(state)
+        # the controller assigned at least the initial config somewhere
+        assert (np.asarray(state.node_state["cfg_n"])[:, :3] >= 1).any()
+
+    def test_minipg_stack(self):
+        from madsim_tpu.models.minipg import make_minipg_runtime
+        cfg = SimConfig(n_nodes=3, event_capacity=64, payload_words=8,
+                        time_limit=sec(10),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(8)))
+        rt = make_minipg_runtime(n_clients=2, n_txns=4, cfg=cfg)
+        state = run_seeds(rt, np.arange(8), max_steps=8_000)
+        _healthy(state)
+
+    def test_kv_on_raft_stack(self):
+        # partial histories (resp = -1 pending) are valid checker input:
+        # the fast tier really does run the linearizability oracle
+        from madsim_tpu.models.raft_kv import (extract_histories,
+                                               make_kv_runtime)
+        rt = make_kv_runtime(n_raft=3, n_clients=2, n_keys=2, n_ops=6,
+                             log_capacity=32)
+        state = run_seeds(rt, np.arange(8), max_steps=8_000)
+        _healthy(state)
+        for h in extract_histories(state, 3, 2):
+            assert check_kv_history(h)
+
+    def test_bank_stack(self):
+        from madsim_tpu.models.bank import make_bank_runtime
+        rt = make_bank_runtime(n_raft=3, n_clients=2, n_ops=6,
+                               log_capacity=32)
+        state = run_seeds(rt, np.arange(8), max_steps=10_000)
+        _healthy(state)
+        totals = np.asarray(state.node_state["h_total"])[:, 3:]
+        resp = np.asarray(state.node_state["h_resp"])[:, 3:]
+        seen = totals[resp >= 0]
+        assert (seen == 600).all()      # conservation on whatever landed
+
+    def test_streaming_stack(self):
+        from madsim_tpu.models.stream_echo import make_stream_echo_runtime
+        cfg = SimConfig(n_nodes=3, event_capacity=64, payload_words=8,
+                        time_limit=sec(8),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(8)))
+        rt = make_stream_echo_runtime("bidi", n_clients=2, n_items=6,
+                                      cfg=cfg)
+        state = run_seeds(rt, np.arange(8), max_steps=6_000)
+        _healthy(state)
+
+    def test_ministream_stack(self):
+        from madsim_tpu.models.ministream import make_ministream_runtime
+        rt = make_ministream_runtime(k=8, epochs=4)
+        state = run_seeds(rt, np.arange(48), max_steps=10_000)
+        _healthy(state)
