@@ -1,0 +1,23 @@
+"""Shared device preflight for the runnable examples.
+
+This environment may pin jax at a TPU tunnel (a sitecustomize registers
+the axon platform whenever PALLAS_AXON_POOL_IPS is set); a WEDGED tunnel
+then hangs the first backend touch forever. Probe it in a killable child
+and fall back to CPU — but ONLY when the tunnel env var is present:
+without it there is no hang risk, and the user's platform choice
+(default, or an explicit JAX_PLATFORMS) must be respected.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def ensure_safe_backend():
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return          # no tunnel pin: nothing can wedge
+    from bench import _force_cpu_inprocess, _tpu_alive
+    if not _tpu_alive():
+        _force_cpu_inprocess()

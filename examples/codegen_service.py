@@ -15,12 +15,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# bounded device preflight: a wedged TPU tunnel hangs the first backend
-# touch forever, so probe in a killable child and fall back to CPU
-from bench import _tpu_alive, _force_cpu_inprocess  # noqa: E402
+from _preflight import ensure_safe_backend  # noqa: E402
 
-if not _tpu_alive():
-    _force_cpu_inprocess()
+ensure_safe_backend()   # CPU fallback iff a wedged TPU tunnel would hang us
 
 import jax.numpy as jnp
 import numpy as np

@@ -20,6 +20,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from _preflight import ensure_safe_backend  # noqa: E402
+
+ensure_safe_backend()   # CPU fallback iff a wedged TPU tunnel would hang us
+
 import numpy as np
 
 from madsim_tpu import Scenario, explore, minimize_scenario, ms
