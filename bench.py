@@ -77,6 +77,31 @@ def _events_per_sec(batch: int, steps: int, warm: int, make=None) -> float:
     return batch * steps / dt
 
 
+def _native_baseline_eps(seeds: int = 200, events_per_seed: int = 4096):
+    """The second baseline denominator: native/simloop.cpp — a tight C++
+    discrete-event loop (heap + random tie-break + RNG loss/latency draws)
+    of the SAME flagship workload, one seed at a time on one core (the
+    task.rs:110-124 execution model, minus Rust async machinery). Measures
+    the chaos-heavy first `events_per_seed` events per seed — the same
+    event range the batched side is timed on. Returns None without a C++
+    toolchain; sanity-checks that the workload actually elects and commits
+    so a silently-broken twin can't set the denominator."""
+    from madsim_tpu.native import native_baseline_run
+    if native_baseline_run(0, 64) is None:
+        return None
+    tot_ev, tot_wall, commits, elections = 0, 0.0, 0, 0
+    for seed in range(seeds):
+        r = native_baseline_run(seed, events_per_seed)
+        tot_ev += r["events"]
+        tot_wall += r["wall_s"]
+        commits = max(commits, r["max_commit"])
+        elections += r["elections"]
+    assert commits > 0 and elections >= seeds, \
+        f"native twin not exercising the workload ({commits=}, {elections=})"
+    return dict(events_per_sec=tot_ev / tot_wall, seeds=seeds,
+                events_per_seed=events_per_seed, max_commit=commits)
+
+
 def _force_cpu_inprocess():
     """Switch THIS process to the host platform. Env vars alone do NOT
     unpin the sitecustomize-registered TPU platform — the config update
@@ -398,10 +423,15 @@ def _realworld_mode():
     shapes = {"pingpong": 1, "fanout": 16}
     modes = {"eager": {}, "compiled": {"compiled": True},
              "batched": {"batch_drain": 64}}
-    port = 19900
+    variant_idx = 0
     for wname, n_cli in shapes.items():
         variants = {}
         for mname, kw in modes.items():
+            # ports advance exactly once per variant regardless of how
+            # far construction/run got (a mid-run failure must not make
+            # the next variant reuse sockets or skip a block)
+            port = 19900 + 20 * variant_idx
+            variant_idx += 1
             try:
                 # a target the run can never finish: throughput-bound,
                 # not workload-bound (each client issues back-to-back)
@@ -413,7 +443,6 @@ def _realworld_mode():
                     base_port=port, **kw)
                 if kw.get("batch_drain"):
                     rt.drain_delay = 0.002   # coalesce for drain depth
-                port += 20
                 rt.run(duration=DUR)
                 assert not rt.crashed, rt.crashed  # a crash is not a datum
                 served = int(rt.states()[0]["served"])
@@ -425,7 +454,6 @@ def _realworld_mode():
                       file=sys.stderr)
             except Exception as e:  # noqa: BLE001 - partial evidence > none
                 variants[mname] = f"{type(e).__name__}: {e}"
-                port += 20
         if isinstance(variants.get("eager"), float):
             for m in ("compiled", "batched"):
                 if isinstance(variants.get(m), float):
@@ -593,6 +621,9 @@ def main():
         # single-seed sequential loop on CPU: the reference execution model
         print(_events_per_sec(1, CPU_STEPS, WARM))
         return
+    if "--native-baseline" in sys.argv:
+        print(json.dumps(_native_baseline_eps() or {"error": "no toolchain"}))
+        return
 
     # CPU baseline in a clean subprocess (this process may own the TPU)
     out = subprocess.run(
@@ -602,6 +633,10 @@ def main():
     cpu_eps = float(out.stdout.strip().splitlines()[-1])
     print(f"cpu single-seed baseline: {cpu_eps:,.0f} events/s",
           file=sys.stderr)
+    native = _native_baseline_eps()
+    if native:
+        print(f"native single-seed baseline: "
+              f"{native['events_per_sec']:,.0f} events/s", file=sys.stderr)
 
     # No chip answering means batched-on-CPU, so the round still records
     # a real speedup number instead of a traceback.
@@ -615,6 +650,14 @@ def main():
         "unit": "seed*events/s (5-node Raft, chaos scenario)",
         "vs_baseline": round(batched_eps / cpu_eps, 2),
     }
+    if native:
+        # second denominator (BASELINE.md §native): a tight C++ DES of the
+        # SAME workload, single seed — an UPPER bound on the reference's
+        # per-seed rate (no async-runtime/serialization overhead, and none
+        # of the engine's per-event invariant/schedule-hash work)
+        result["native_baseline_eps"] = round(native["events_per_sec"], 1)
+        result["vs_native_baseline"] = round(
+            batched_eps / native["events_per_sec"], 3)
     last_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_TPU_LAST.json")
     if on_tpu:

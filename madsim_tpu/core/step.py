@@ -96,6 +96,10 @@ def make_step(
     assert node_prog.min() >= 0 and node_prog.max() < len(programs)
     node_prog_j = jnp.asarray(node_prog)
     P = cfg.payload_words
+    # emission-write lowering (types.py): values identical either way;
+    # resolved once at trace time so the whole step compiles one form
+    em_scatter = cfg.emission_write == "scatter" or (
+        cfg.emission_write == "auto" and jax.default_backend() == "cpu")
     spec_default = jax.tree.map(lambda a: jnp.asarray(a), state_spec)
     if persist is None:
         persist_mask = jax.tree.map(lambda a: False, spec_default)
@@ -277,7 +281,7 @@ def make_step(
         if E > 0:
             free = s.t_kind == T.EV_FREE
             occupied_now = (~free).sum(dtype=jnp.int32)
-            slots, slot_ok = sel.first_k_free(free, E)
+            slots, slot_ok = sel.first_k_free(free, E, scatter=em_scatter)
             # per-send: loss + latency keys; per-emission (send AND
             # timer): one micro-jitter key (net/mod.rs:151-156 — the
             # reference random-delays EVERY network op). STATICALLY
@@ -340,26 +344,43 @@ def make_step(
 
             w = jnp.stack(em_write)                      # [E] bool
             high_water = occupied_now + w.sum(dtype=jnp.int32)
-            # one-hot write instead of an [E]-index scatter (serializes on
-            # TPU, ~10ns/element): real slots are distinct by construction,
-            # so summing the one-hot rows yields each written value exactly
-            # once; masked-off emissions match no column and write nothing
-            slots_eff = jnp.where(w, slots,
-                                  jnp.asarray(cfg.event_capacity, jnp.int32))
-            slot_oh = slots_eff[:, None] == jnp.arange(
-                cfg.event_capacity, dtype=jnp.int32)     # [E, C]
-            written = slot_oh.any(0)                     # [C]
+            if em_scatter:
+                # O(E) scatter per column: real slots are distinct by
+                # construction; masked-off emissions target DISTINCT
+                # out-of-range rows (C + j) so `unique_indices` holds and
+                # mode="drop" discards them
+                slots_eff = jnp.where(
+                    w, slots,
+                    cfg.event_capacity + jnp.arange(E, dtype=jnp.int32))
 
-            def put(col, vals):
-                v = jnp.stack(vals)                      # [E] or [E, P]
-                ohi = slot_oh.astype(v.dtype)
-                if v.ndim == 1:
-                    upd = (ohi * v[:, None]).sum(0)
-                    # cast, not promote: staged values are int32 but the
-                    # column may be a narrow (table_dtype) dtype
-                    return jnp.where(written, upd, col).astype(col.dtype)
-                upd = jnp.einsum("ec,ep->cp", ohi, v)
-                return jnp.where(written[:, None], upd, col)
+                def put(col, vals):
+                    v = jnp.stack(vals)                  # [E] or [E, P]
+                    return col.at[slots_eff].set(
+                        v.astype(col.dtype), mode="drop",
+                        unique_indices=True)
+            else:
+                # one-hot write instead of an [E]-index scatter (serializes
+                # on TPU, ~10ns/element): real slots are distinct by
+                # construction, so summing the one-hot rows yields each
+                # written value exactly once; masked-off emissions match no
+                # column and write nothing. The [E, C] product is what the
+                # scatter form above avoids on CPU (width tax, DESIGN §5).
+                slots_eff = jnp.where(
+                    w, slots, jnp.asarray(cfg.event_capacity, jnp.int32))
+                slot_oh = slots_eff[:, None] == jnp.arange(
+                    cfg.event_capacity, dtype=jnp.int32)     # [E, C]
+                written = slot_oh.any(0)                     # [C]
+
+                def put(col, vals):
+                    v = jnp.stack(vals)                      # [E] or [E, P]
+                    ohi = slot_oh.astype(v.dtype)
+                    if v.ndim == 1:
+                        upd = (ohi * v[:, None]).sum(0)
+                        # cast, not promote: staged values are int32 but the
+                        # column may be a narrow (table_dtype) dtype
+                        return jnp.where(written, upd, col).astype(col.dtype)
+                    upd = jnp.einsum("ec,ep->cp", ohi, v)
+                    return jnp.where(written[:, None], upd, col)
 
             s = s.replace(
                 t_deadline=put(s.t_deadline, em_deadline),

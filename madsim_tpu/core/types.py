@@ -91,11 +91,13 @@ class NetConfig:
     packet_loss_rate: float = 0.0
     send_latency_min: int = 1 * TICKS_PER_MS
     send_latency_max: int = 10 * TICKS_PER_MS
-    # per-op micro-jitter: 0..op_jitter_max ticks (inclusive) added to every
-    # send's latency draw AND every timer's deadline — the analog of the
-    # reference's random 0-5 us delay before each network op
-    # (net/mod.rs:151-156), which widens explored interleavings beyond
-    # message-latency jitter. STATIC gate, dynamic bound: 0 (default)
+    # per-op micro-jitter: 0..op_jitter_max ticks (INCLUSIVE) added to every
+    # send's latency draw AND every timer's deadline. Inspired by — but
+    # deliberately wider than — the reference's rand_delay
+    # (net/mod.rs:151-156), which draws gen_range(0..5) (EXCLUSIVE, 0-4 us)
+    # and wraps network ops only; jittering timer deadlines too widens
+    # explored interleavings beyond what the reference perturbs.
+    # STATIC gate, dynamic bound: 0 (default)
     # compiles the fold out entirely (zero extra draws on the emission
     # phase); > 0 compiles it in, and the bound then lives in
     # SimState.jitter where set-ops/overrides can tune it without
@@ -176,6 +178,16 @@ class SimConfig:
     # and fingerprints are BIT-IDENTICAL across this knob — a pure
     # bandwidth lever, not a replay domain.
     table_dtype: str = "int32"
+    # emission-write lowering: how staged emissions land in the event
+    # table. "onehot" = [E, C] one-hot masked-sum (VPU-friendly — the TPU
+    # default); "scatter" = one XLA scatter per column at distinct slot
+    # rows (O(E) work — the CPU default: the [E, C] product is the
+    # dominant term of the measured n^1.8 cluster-width tax, DESIGN §5).
+    # "auto" resolves by backend at trace time. Written VALUES are
+    # identical across all three, so trajectories and fingerprints are
+    # BIT-IDENTICAL — a lowering lever like table_dtype, not a replay
+    # domain (unlike `scheduler`).
+    emission_write: str = "auto"
 
     def __post_init__(self):
         assert self.n_nodes >= 1
@@ -183,6 +195,7 @@ class SimConfig:
         assert self.payload_words >= 1
         assert self.scheduler in ("reference", "fused")
         assert self.table_dtype in ("int32", "int16")
+        assert self.emission_write in ("auto", "onehot", "scatter")
         if self.table_dtype == "int16":
             assert self.n_nodes < 2**15, "int16 t_node caps nodes at 32767"
 

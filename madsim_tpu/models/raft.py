@@ -573,7 +573,7 @@ class Raft(Program):
 
 
 def raft_invariant(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
-                   raft_nodes=None):
+                   raft_nodes=None, window_slides: bool = True):
     """Global safety checks, evaluated after every event.
 
     Election Safety: at most one leader per term — the task.rs analog would
@@ -583,6 +583,20 @@ def raft_invariant(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
     raft_nodes: optional bool mask [N] restricting the checks to the raft
     peers in mixed clusters (client nodes share the schema but not the
     protocol).
+
+    window_slides: STATIC choice of the prefix-agreement form. True (the
+    sound default) uses the pairwise [N,N,L+1] chain evaluation — correct
+    for any snap_len configuration. False asserts the builder KNOWS the
+    log window never slides (compact_threshold=0 and therefore no
+    InstallSnapshot either: with no compacting leader, s_len > snap_len
+    never arrives), and uses the commit-sorted ADJACENT chain check —
+    O(N·L + N²) instead of O(N²·L), the width-tax fix of DESIGN §5. The
+    two are coverage-equivalent (up to int32-hash collision) ONLY when
+    snap_len ≡ 0: a slid window can void an adjacent link (a node
+    compacted past its sorted predecessor's commit) and a voided link
+    breaks the transitivity that the pairwise form does not need — a
+    code-review-confirmed soundness gap, hence the static gate rather
+    than a dynamic fallback (under vmap both cond branches would run).
     """
     N, L = n_nodes, log_capacity
     eye = jnp.eye(N, dtype=bool)
@@ -606,7 +620,6 @@ def raft_invariant(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
         ec = jnp.maximum(jnp.where(peer, ns["commit"], 0), sl)
         dig = ns["snap_digest"]
         h = entry_hash(ns["log_term"], [ns[f"log_{f}"] for f in fields])
-        pair = peer[:, None] & peer[None, :] & ~eye
 
         # State Machine Safety via PREFIX DIGEST CHAINS. Define, per node,
         #   chain(t) = P^t * (snap_digest + sum_{k<t} h[k] * P^{-(k+1)})
@@ -617,26 +630,53 @@ def raft_invariant(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
         # odd, hence invertible mod 2^32, which is what makes the cumsum
         # form exact in int32 wraparound arithmetic). Committed prefixes
         # agree iff both nodes' chains agree at the deepest common
-        # committed point a = min(ec_i, ec_j) — ONE int32 compare per pair.
-        # This replaces the entry-by-entry [N,N,L] aligned gather, which at
-        # ~10ns/element made the safety check 78% of the whole TPU step; a
-        # content mismatch anywhere below `a` now surfaces as a chain
-        # mismatch (up to int32-hash collision — the stance the digest
-        # design already takes for compacted history, extended to the live
-        # window).
+        # committed point a = min(ec_i, ec_j) — chain equality at a point
+        # means prefix equality up to it, up to int32-hash collision (the
+        # stance the digest design already takes for compacted history).
         S = jnp.cumsum(h * ipowP[None, 1:L + 1], axis=1)        # [N, L]
         S = jnp.concatenate([jnp.zeros((N, 1), jnp.int32), S], axis=1)
         chain = powP[None, :] * (dig[:, None] + S)              # [N, L+1]
-        a = jnp.minimum(ec[:, None], ec[None, :])               # [N, N] sym
-        t_i = a - sl[:, None]           # evaluation point in i's window
-        # i can evaluate its chain only at t in [0, L] (points at or above
-        # its own snapshot); same old applicability condition, both ways
-        ok_i = (t_i >= 0) & (t_i <= L)
-        oh = (jnp.clip(t_i, 0, L)[:, :, None]
-              == jnp.arange(L + 1, dtype=jnp.int32))            # [N,N,L+1]
-        ci = jnp.where(oh, chain[:, None, :], 0).sum(-1)        # chain_i(a)
-        cj = ci.T                       # a is symmetric: chain_j at a_ij
-        mismatch = (pair & ok_i & ok_i.T & (ci != cj)).any()
+        ts = jnp.arange(L + 1, dtype=jnp.int32)
+        if window_slides:
+            # sound for any snap_len: evaluate every pair at its own
+            # deepest common committed point (one [N,N,L+1] one-hot)
+            pair = peer[:, None] & peer[None, :] & ~eye
+            a = jnp.minimum(ec[:, None], ec[None, :])           # [N, N] sym
+            t_i = a - sl[:, None]       # evaluation point in i's window
+            ok_i = (t_i >= 0) & (t_i <= L)
+            oh = jnp.clip(t_i, 0, L)[:, :, None] == ts          # [N,N,L+1]
+            ci = jnp.where(oh, chain[:, None, :], 0).sum(-1)    # chain_i(a)
+            cj = ci.T                   # a is symmetric: chain_j at a_ij
+            mismatch = (pair & ok_i & ok_i.T & (ci != cj)).any()
+        else:
+            # window statically pinned at zero: check along the
+            # COMMIT-SORTED ADJACENT CHAIN — node k+1 agrees with node k
+            # at ec_k; with every link evaluable (sl == 0 always), adjacent
+            # agreement composes transitively to every pair. TWO [N,L+1]
+            # evaluations + [N]-vector permutes replace the [N,N,L+1]
+            # product (which replaced the r2 entry-by-entry [N,N,L]
+            # aligned gather, 78% of the TPU step at the time).
+            # X_i = chain_i at its OWN ec (in-window: 0 <= ec <= log_len <= L)
+            ohX = (ec - sl)[:, None] == ts
+            X = jnp.where(ohX, chain, 0).sum(-1)                # [N]
+            # sorted order over peers (non-peers pushed last, never checked)
+            imax = jnp.asarray(2**31 - 1, jnp.int32)
+            order = jnp.argsort(jnp.where(peer, ec, imax))      # [N]
+            ids = jnp.arange(N, dtype=jnp.int32)
+            rank = jnp.where(ids[None, :] == order[:, None], ids[:, None],
+                             0).sum(0)                          # rank[node]
+            ec_sorted = take1(ec, order)
+            # prev_ec[i] = ec of the peer ranked immediately below i
+            prev_ec = take1(ec_sorted, jnp.clip(rank - 1, 0, N - 1))
+            prev_node = take1(order, jnp.clip(rank - 1, 0, N - 1))
+            tY = prev_ec - sl           # my evaluation point for the link
+            okY = (tY >= 0) & (tY <= L)     # belt-and-braces; sl == 0
+            ohY = jnp.clip(tY, 0, L)[:, None] == ts
+            Y = jnp.where(ohY, chain, 0).sum(-1)                # [N]
+            X_prev = take1(X, jnp.clip(prev_node, 0, N - 1))
+            link = peer & take1(peer, jnp.clip(prev_node, 0, N - 1)) \
+                & (rank > 0) & okY
+            mismatch = (link & (Y != X_prev)).any()
 
         commit_gt = (ec > loglen).any()
 
@@ -660,5 +700,10 @@ def make_raft_runtime(n_nodes=5, log_capacity=32, n_cmds=8,
     prog = Raft(n_nodes, log_capacity, n_cmds, halt_on_commit, **raft_kw)
     return Runtime(cfg, [prog], state_spec(n_nodes, log_capacity),
                    scenario=scenario,
-                   invariant=raft_invariant(n_nodes, log_capacity),
+                   invariant=raft_invariant(
+                       n_nodes, log_capacity,
+                       # no compaction => snap_len pinned at 0 => the cheap
+                       # adjacent-chain form is coverage-equivalent
+                       window_slides=bool(
+                           raft_kw.get("compact_threshold", 0))),
                    persist=persist_spec())

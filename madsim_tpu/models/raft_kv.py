@@ -362,8 +362,13 @@ def make_kv_runtime(n_raft=5, n_clients=3, n_keys=4, n_ops=12,
     rt = Runtime(cfg, [prog_raft, prog_client],
                  kv_state_spec(n, log_capacity, n_ops, n_keys, n_clients),
                  node_prog=node_prog, scenario=scenario,
-                 invariant=R.raft_invariant(n, log_capacity, KV_FIELDS,
-                                            peer_mask),
+                 invariant=R.raft_invariant(
+                     n, log_capacity, KV_FIELDS, peer_mask,
+                     # compaction slides the window; only a statically
+                     # pinned snap_len==0 build may use the cheap
+                     # adjacent-chain form (see raft_invariant docstring)
+                     window_slides=bool(
+                         raft_kw.get("compact_threshold", 0))),
                  persist=kv_persist_spec(),
                  halt_when=(all_clients_done(n_raft, n_ops)
                             if halt_when_all_done else None))

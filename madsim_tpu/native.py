@@ -20,9 +20,13 @@ import numpy as np
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_ROOT, "native", "linearize.cpp")
 _SO = os.path.join(_ROOT, "native", "liblinearize.so")
+_SIM_SRC = os.path.join(_ROOT, "native", "simloop.cpp")
+_SIM_SO = os.path.join(_ROOT, "native", "libsimloop.so")
 
 _lib = None
 _lib_tried = False
+_simlib = None
+_simlib_tried = False
 
 
 def _load():
@@ -51,6 +55,49 @@ def _load():
               file=sys.stderr)
         _lib = None
     return _lib
+
+
+def _load_simloop():
+    """native/simloop.cpp — the single-seed discrete-event baseline twin
+    (the reference execution-model stand-in, task.rs:110-124). No Python
+    fallback: this engine at batch=1 IS the fallback denominator, and a
+    Python rewrite would misstate the native rate it exists to measure."""
+    global _simlib, _simlib_tried
+    if _simlib is not None or _simlib_tried:
+        return _simlib
+    _simlib_tried = True
+    try:
+        if (not os.path.exists(_SIM_SO)
+                or os.path.getmtime(_SIM_SO) < os.path.getmtime(_SIM_SRC)):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", _SIM_SO, _SIM_SRC],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(_SIM_SO)
+        lib.simloop_run.restype = None
+        lib.simloop_run.argtypes = [
+            ctypes.c_uint64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+        _simlib = lib
+    except Exception as e:
+        print(f"madsim_tpu.native: simloop unavailable ({e})",
+              file=sys.stderr)
+        _simlib = None
+    return _simlib
+
+
+def native_baseline_run(seed: int, max_events: int) -> dict | None:
+    """Run the native single-seed flagship workload for `max_events`
+    events; returns {events, wall_s, events_per_sec, max_commit,
+    elections} or None when no C++ toolchain is available."""
+    lib = _load_simloop()
+    if lib is None:
+        return None
+    out = np.zeros(4, np.int64)
+    lib.simloop_run(seed, max_events, out)
+    ev, ns = int(out[0]), max(int(out[1]), 1)
+    return dict(events=ev, wall_s=ns / 1e9,
+                events_per_sec=ev / (ns / 1e9),
+                max_commit=int(out[2]), elections=int(out[3]))
 
 
 def _check_register_py(op, val, inv, resp) -> bool:

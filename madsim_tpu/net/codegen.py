@@ -63,9 +63,15 @@ def parse(text: str):
             f"message {name}: nested messages are unsupported — payloads "
             "are flat fixed-width word vectors")
         fields = []
+        assert name not in messages, f"duplicate message {name}"
+        seen_nums = set()
         for line in filter(None, (s.strip() for s in body.split(";"))):
-            fm = re.match(r"(repeated\s+)?(\w+)\s+(\w+)\s*=\s*\d+$", line)
+            fm = re.match(r"(repeated\s+)?(\w+)\s+(\w+)\s*=\s*(\d+)$", line)
             assert fm, f"unparseable field in message {name}: {line!r}"
+            fnum = int(fm.group(4))
+            assert fnum not in seen_nums, (
+                f"message {name}: duplicate field number {fnum}")
+            seen_nums.add(fnum)
             assert not fm.group(1), (
                 f"{name}.{fm.group(3)}: repeated fields are unsupported — "
                 "payloads are fixed-width word vectors; use explicit "
@@ -77,6 +83,7 @@ def parse(text: str):
             fields.append((ftype, fm.group(3)))
         messages[name] = fields
     for name, body in _blocks(text, "service"):
+        assert name not in services, f"duplicate service {name}"
         assert "{" not in body, (
             f"service {name}: rpc options blocks ('rpc ... {{}}') are "
             "unsupported — end each rpc with ';'")
@@ -87,6 +94,9 @@ def parse(text: str):
             meth, req_s, req, rsp_s, rsp = rm.groups()
             assert req in messages, f"{name}.{meth}: unknown message {req}"
             assert rsp in messages, f"{name}.{meth}: unknown message {rsp}"
+            assert meth not in (r[0] for r in rpcs), (
+                f"service {name}: duplicate rpc {meth} — the generated "
+                "class would silently shadow the first definition")
             rpcs.append((meth, req, bool(req_s), rsp, bool(rsp_s)))
         services[name] = rpcs
     return messages, services

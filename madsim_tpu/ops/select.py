@@ -81,17 +81,30 @@ def put_row(mat, idx, val, mask=True):
     return jnp.where(oh & mask, val, mat)
 
 
-def first_k_free(free_mask, k: int):
+def first_k_free(free_mask, k: int, scatter: bool = False):
     """Indices of the first k free slots (stable by index).
 
     Returns (slots:int32[k], ok:bool[k]) where ok[j] is False when fewer than
-    j+1 slots are free. Cumsum rank-match instead of a sort: slot j is the
-    position whose running count of free slots equals j+1 — O(kC) compares,
-    far cheaper on the VPU than an argsort over the event table.
+    j+1 slots are free; not-ok rows return slot 0 (callers gate on ok).
+
+    Two lowerings, identical results (the emission_write knob,
+    types.py): the default cumsum rank-match is O(kC) compares — cheap on
+    the TPU VPU, but the k*C product is quadratic in cluster width when
+    k ~ n and C = 16n (DESIGN §5 width tax); `scatter=True` writes each
+    free slot's index into its rank row instead — one O(C) scatter, the
+    CPU-friendly form.
     """
     pos = jnp.cumsum(free_mask.astype(jnp.int32))
-    targets = jnp.arange(1, k + 1, dtype=jnp.int32)
-    eq = (pos[None, :] == targets[:, None]) & free_mask[None, :]
-    slots = jnp.argmax(eq, axis=1).astype(jnp.int32)
-    ok = targets <= (pos[-1] if pos.shape[0] else 0)
+    if scatter:
+        C = free_mask.shape[0]
+        rank = pos - 1
+        dst = jnp.where(free_mask & (rank < k), rank, k)   # k = dropped
+        slots = jnp.zeros((k,), jnp.int32).at[dst].set(
+            jnp.arange(C, dtype=jnp.int32), mode="drop")
+    else:
+        targets = jnp.arange(1, k + 1, dtype=jnp.int32)
+        eq = (pos[None, :] == targets[:, None]) & free_mask[None, :]
+        slots = jnp.argmax(eq, axis=1).astype(jnp.int32)
+    ok = jnp.arange(1, k + 1, dtype=jnp.int32) \
+        <= (pos[-1] if pos.shape[0] else 0)
     return slots, ok

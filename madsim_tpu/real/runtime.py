@@ -617,6 +617,7 @@ class RealRuntime:
             # real send: straight to the peer; latency, loss, and
             # reordering are whatever the real backend does
             self._net.send(n.id, dst, pkt)
+        cancelled_tags = set()
         for e in ctx._cancels:
             if not bool(e["m"]):
                 continue
@@ -633,17 +634,20 @@ class RealRuntime:
             n.timers = [(tg, h) for tg, h in n.timers if tg != t]
             n.parked = [(kind, args) for kind, args in n.parked
                         if not (kind == "timer" and int(args[0]) == t)]
-            # batched mode: also purge matching timer firings already
-            # sitting in the drain queue (a handle that fired during the
-            # coalescing window), mirroring per-event semantics where
-            # the cancel lands before the call_later fires. Events of
-            # the SAME drain are inherently concurrent — a cancel
-            # cannot retract a firing that ran earlier in its own scan;
-            # the call-id payload idiom covers that residual window.
-            if self.batch_drain:
-                self._queue = [ev for ev in self._queue
-                               if not (ev[0] == n.id and ev[1] == 2
-                                       and int(ev[3]) == t)]
+            cancelled_tags.add(t)
+        # batched mode: also purge matching timer firings already
+        # sitting in the drain queue (a handle that fired during the
+        # coalescing window), mirroring per-event semantics where
+        # the cancel lands before the call_later fires. Events of
+        # the SAME drain are inherently concurrent — a cancel
+        # cannot retract a firing that ran earlier in its own scan;
+        # the call-id payload idiom covers that residual window.
+        # ONE filter pass for all of this handler's cancels: a per-cancel
+        # rebuild would be O(cancels x queue_len) per drain.
+        if self.batch_drain and cancelled_tags:
+            self._queue = [ev for ev in self._queue
+                           if not (ev[0] == n.id and ev[1] == 2
+                                   and int(ev[3]) in cancelled_tags)]
         for e in ctx._timers:
             if not bool(e["m"]):
                 continue

@@ -1,0 +1,67 @@
+"""The emission_write lowering knob (types.py) must be value-invisible:
+"onehot" and "scatter" are two XLA lowerings of the SAME table write, so
+trajectories, fingerprints, and schedule hashes must be BIT-IDENTICAL
+across them (unlike `scheduler`, which is a replay domain). This is the
+same differential-pinning idiom as test_pallas_select's interpret-mode
+checks: the cheap form proves the fast form."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu import NetConfig, Scenario, SimConfig, ms, sec
+from madsim_tpu.models.raft import make_raft_runtime
+from madsim_tpu.ops import select as sel
+
+
+class TestFirstKFreeLowerings:
+    @pytest.mark.parametrize("k", [1, 3, 8])
+    def test_scatter_matches_rank_match(self, k):
+        rng = np.random.default_rng(7)
+        for _ in range(32):
+            free = jnp.asarray(rng.random(24) < rng.random())
+            s_a, ok_a = sel.first_k_free(free, k, scatter=False)
+            s_b, ok_b = sel.first_k_free(free, k, scatter=True)
+            assert (np.asarray(ok_a) == np.asarray(ok_b)).all()
+            # not-ok rows are gated by callers; compare only the real ones
+            m = np.asarray(ok_a)
+            assert (np.asarray(s_a)[m] == np.asarray(s_b)[m]).all()
+
+    def test_all_free_and_none_free(self):
+        for free in (jnp.ones(16, bool), jnp.zeros(16, bool)):
+            s_a, ok_a = sel.first_k_free(free, 4, scatter=False)
+            s_b, ok_b = sel.first_k_free(free, 4, scatter=True)
+            assert (np.asarray(ok_a) == np.asarray(ok_b)).all()
+            m = np.asarray(ok_a)
+            assert (np.asarray(s_a)[m] == np.asarray(s_b)[m]).all()
+
+
+def _rt(emission_write):
+    sc = Scenario()
+    sc.at(ms(300)).kill_random()
+    sc.at(ms(700)).restart_random()
+    sc.at(ms(900)).partition([0, 1])
+    sc.at(ms(1300)).heal()
+    cfg = SimConfig(n_nodes=5, event_capacity=96, time_limit=sec(30),
+                    net=NetConfig(packet_loss_rate=0.05),
+                    emission_write=emission_write)
+    return make_raft_runtime(5, log_capacity=16, n_cmds=6, scenario=sc,
+                             cfg=cfg)
+
+
+class TestEndToEndBitIdentical:
+    def test_chaos_raft_state_identical_across_lowerings(self):
+        seeds = np.arange(8)
+        final = {}
+        for mode in ("onehot", "scatter"):
+            rt = _rt(mode)
+            st, _ = rt.run(rt.init_batch(seeds), 768)
+            final[mode] = jax.tree.map(np.asarray, st)
+        a, b = final["onehot"], final["scatter"]
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            assert la.dtype == lb.dtype
+            assert (la == lb).all()
+        # the knob must not leak into replay identity the way `scheduler`
+        # does: schedule hashes agree too
+        assert (np.asarray(a.sched_hash) == np.asarray(b.sched_hash)).all()
