@@ -125,20 +125,10 @@ def make_step(
         parked = (sel.take1(parked_nodes, tnode)
                   & (s.t_kind != T.EV_SUPER))  # paused nodes park their events
         eligible = occupied & ~parked
-        if cfg.scheduler == "fused":
-            # one VMEM pass over the [C] table slice (ops/pallas_select.py)
-            # instead of two XLA reductions; its tie-break draws from hash
-            # priorities, so "fused" is its own replay domain (types.py)
-            from ..ops.pallas_select import fused_select_lane
-            rb = jax.random.bits(k_sched, (), jnp.uint32).astype(jnp.int32)
-            dmin, idx, any_ev = fused_select_lane(s.t_deadline, eligible,
-                                                  rb, inf=T.T_INF)
-            valid = any_ev & live
-        else:
-            dmin, at_min, any_ev = sel.min_deadline(s.t_deadline, eligible,
-                                                    T.T_INF)
-            idx, picked = sel.masked_choice(k_sched, at_min)
-            valid = picked & any_ev & live
+        dmin, at_min, any_ev = sel.min_deadline(s.t_deadline, eligible,
+                                                T.T_INF)
+        idx, picked = sel.masked_choice(k_sched, at_min)
+        valid = picked & any_ev & live
 
         ev_kind = jnp.where(valid, sel.take1(s.t_kind, idx), T.EV_FREE)
         ev_node_raw = sel.take1(s.t_node, idx)  # may be NODE_RANDOM (super)

@@ -164,13 +164,11 @@ class SimConfig:
     time_limit: int = 10 * TICKS_PER_SEC
     net: NetConfig = dataclasses.field(default_factory=NetConfig)
     collect_stats: bool = True
-    # scheduler backend: "reference" = the unfused XLA reductions
-    # (ops/select.py); "fused" = the Pallas VMEM-pass kernel
-    # (ops/pallas_select.py). Both draw the same-deadline tie-break
-    # uniformly but from DIFFERENT bits, so each value is its own replay
-    # domain — seeds reproduce within a scheduler, not across them (the
-    # config hash covers this field, so a repro line pins it).
-    scheduler: str = "reference"
+    # (the r3 opt-in "fused" Pallas scheduler was CUT in r5: three rounds
+    # without on-hardware justification, a separate replay domain to
+    # maintain, and the roofline (DESIGN §5) shows the select phase is
+    # too small a slice of per-step bytes for a select-only kernel to
+    # pay — the whole-step VMEM-resident kernel is the real Pallas play)
     # narrow event-table columns: "int16" stores t_kind/t_node/t_src in
     # half the bytes (the [batch, C] table dominates step cost — DESIGN
     # §5b; t_tag stays int32: service tags are 29-bit hashes, t_deadline
@@ -186,14 +184,13 @@ class SimConfig:
     # "auto" resolves by backend at trace time. Written VALUES are
     # identical across all three, so trajectories and fingerprints are
     # BIT-IDENTICAL — a lowering lever like table_dtype, not a replay
-    # domain (unlike `scheduler`).
+    # domain.
     emission_write: str = "auto"
 
     def __post_init__(self):
         assert self.n_nodes >= 1
         assert self.event_capacity >= 4
         assert self.payload_words >= 1
-        assert self.scheduler in ("reference", "fused")
         assert self.table_dtype in ("int32", "int16")
         assert self.emission_write in ("auto", "onehot", "scatter")
         if self.table_dtype == "int16":

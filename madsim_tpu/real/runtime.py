@@ -360,7 +360,10 @@ class RealRuntime:
         done = set()
         for m in self._BUCKETS + (self.batch_drain,):
             K = self._bucket(m)
-            if K in done:
+            # K=1 is unreachable since the depth-1 bypass (a one-event
+            # drain runs per-event compiled dispatch) — don't pay its
+            # compile at startup
+            if K in done or K == 1:
                 continue
             done.add(K)
             out = fn(stacked, jnp.asarray(0, jnp.int32),
@@ -407,11 +410,7 @@ class RealRuntime:
                 src, tag, pl = args[0], args[1], args[2]
             else:
                 src, tag, pl = 0, args[0], args[1]
-            out = self._get_compiled(p_idx, kind)(
-                n.state, node_j, now_j, self._next_key(),
-                jnp.asarray(src, jnp.int32), jnp.asarray(tag, jnp.int32),
-                pl)
-            self._apply(n, _Staged(*out))
+            self._run_compiled_event(n, kind, src, tag, pl)
             return
         prog = self.programs[p_idx]
         ctx = Ctx(self.cfg, node_j, now_j, self._next_key(), n.state)
@@ -425,6 +424,17 @@ class RealRuntime:
             tag, pl = jnp.asarray(args[0], jnp.int32), args[1]
         self._invoke(prog, ctx, kind, src, tag, pl)
         self._apply(n, ctx)
+
+    def _run_compiled_event(self, n: RealNode, kind: str, src, tag, pl):
+        """Per-event compiled dispatch tail — the ONE incantation shared
+        by _dispatch's compiled branch and _drain's depth-1 bypass, so
+        the two paths can never diverge (same rationale as _invoke)."""
+        out = self._get_compiled(self.node_prog[n.id], kind)(
+            n.state, jnp.asarray(n.id, jnp.int32),
+            jnp.asarray(self.now(), jnp.int32), self._next_key(),
+            jnp.asarray(src, jnp.int32), jnp.asarray(tag, jnp.int32),
+            jnp.asarray(pl, jnp.int32))
+        self._apply(n, _Staged(*out))
 
     # -- batched drain ---------------------------------------------------
     def _get_drain_fn(self):
@@ -541,6 +551,18 @@ class RealRuntime:
                 continue
             events.append(ev)
         if not events:
+            return
+        if len(events) == 1:
+            # depth-1 guard rail: a one-event scan amortizes nothing and
+            # pays the stacked-state round-trip (measured 0.64x eager on
+            # the depth-1 ping-pong shape, BENCH_realworld_r04) — run the
+            # event through per-event compiled dispatch instead. Key draw
+            # order is identical (one key per event in both modes).
+            node, kc, src, tag, pl = events[0]
+            self._stacked = None        # per-node write: restack on drain
+            self._run_compiled_event(self.nodes[node],
+                                     ("init", "message", "timer")[kc],
+                                     src, tag, pl)
             return
         import jax
         m = len(events)
@@ -685,6 +707,7 @@ class RealRuntime:
         server, read its recovered state)."""
         if self.batch_drain:
             self._warm_drain()         # before sockets/timers exist
+            self._warm_compiled()      # depth-1 drains bypass to per-event
         elif self.compiled:
             self._warm_compiled()
         self._loop = asyncio.get_running_loop()

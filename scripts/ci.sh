@@ -31,6 +31,10 @@ case "$tier" in
     # multi-chip sharding compiles + executes on a virtual 8-device mesh
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+    # seconds-scale bench self-test: the measurement paths (incl. the
+    # native baseline twin) must not rot — the reference's ci.yml runs
+    # its criterion benches the same way
+    python bench.py --smoke
     ;;
   *)
     echo "usage: scripts/ci.sh [fast|full]" >&2

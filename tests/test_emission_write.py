@@ -1,9 +1,8 @@
 """The emission_write lowering knob (types.py) must be value-invisible:
 "onehot" and "scatter" are two XLA lowerings of the SAME table write, so
 trajectories, fingerprints, and schedule hashes must be BIT-IDENTICAL
-across them (unlike `scheduler`, which is a replay domain). This is the
-same differential-pinning idiom as test_pallas_select's interpret-mode
-checks: the cheap form proves the fast form."""
+across them — this knob must never become a replay domain. The cheap
+form differentially pins the fast form."""
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +61,6 @@ class TestEndToEndBitIdentical:
         for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
             assert la.dtype == lb.dtype
             assert (la == lb).all()
-        # the knob must not leak into replay identity the way `scheduler`
-        # does: schedule hashes agree too
+        # the knob must not leak into replay identity: schedule hashes
+        # agree too
         assert (np.asarray(a.sched_hash) == np.asarray(b.sched_hash)).all()

@@ -1,8 +1,9 @@
 """Single-process sharded-engine tests on the virtual 8-device CPU mesh
 (tests/conftest.py forces it): the seed batch shards with no per-step
-communication, and the r3 engine knobs (int16 table columns, fused
-scheduler) compile and run under a mesh too — the in-process complement
-of the driver's dryrun_multichip and the 2-process suite."""
+communication, and the engine lowering knobs (int16 table columns,
+scatter emission writes) compile and run under a mesh too — the
+in-process complement of the driver's dryrun_multichip and the
+2-process suite."""
 
 import jax
 import numpy as np
@@ -54,14 +55,15 @@ class TestShardedEngine:
         np.testing.assert_array_equal(_fps(rt32, plain),
                                       _fps(rt16, sharded))
 
-    def test_fused_scheduler_shards(self):
-        # the vmapped pallas select partitions along the seed axis
-        rt = _rt(scheduler="fused")
-        sharded = shard_batch(rt.init_batch(np.arange(B)), seed_mesh())
-        state, _ = rt.run(sharded, max_steps=4000)
+    def test_scatter_emission_shards(self):
+        # the scatter emission lowering partitions along the seed axis
+        # and stays bit-identical to the one-hot run under the mesh
+        rt_oh = _rt(emission_write="onehot")
+        plain, _ = rt_oh.run(rt_oh.init_batch(np.arange(B)), max_steps=4000)
+        rt_sc = _rt(emission_write="scatter")
+        sharded = shard_batch(rt_sc.init_batch(np.arange(B)), seed_mesh())
+        state, _ = rt_sc.run(sharded, max_steps=4000)
         assert bool(state.halted.all())
         assert not bool(state.crashed.any())
-        # and it replays bit-stable under the mesh
-        sharded2 = shard_batch(rt.init_batch(np.arange(B)), seed_mesh())
-        state2, _ = rt.run(sharded2, max_steps=4000)
-        np.testing.assert_array_equal(_fps(rt, state), _fps(rt, state2))
+        np.testing.assert_array_equal(_fps(rt_oh, plain),
+                                      _fps(rt_sc, state))

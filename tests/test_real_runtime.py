@@ -223,6 +223,44 @@ class TestBatchedDrain:
         assert not rt.crashed
         assert int(rt.states()[0]["acked"]) >= 8
 
+    def test_depth1_drain_bypasses_scan(self):
+        # guard rail (r5): a one-event drain amortizes nothing (measured
+        # 0.64x eager on the depth-1 ping-pong shape) — it must run
+        # through per-event compiled dispatch, not the scan, with
+        # identical behavior. Ping-pong with one client IS depth-1
+        # traffic, so this workload exercises the bypass end to end; the
+        # post-warm spies prove the bypass actually took it.
+        import asyncio
+
+        n = 2
+        cfg = SimConfig(n_nodes=n, time_limit=sec(10))
+        rt = RealRuntime(cfg, [PingPong(n, target=5, retry=ms(30))],
+                         state_spec(), base_port=19860, batch_drain=8)
+        calls = {"single": 0}
+
+        async def scenario():
+            await rt.start()            # warms both dispatch paths
+            # wrap the post-warm cached per-event fns: any further call
+            # is a real depth-1 bypass, not warmup
+            for k, f in list(rt._compiled_fns.items()):
+                def mk(f=f):
+                    def wrapped(*a):
+                        calls["single"] += 1
+                        return f(*a)
+                    return wrapped
+                rt._compiled_fns[k] = mk()
+            try:
+                await asyncio.wait_for(rt._halted.wait(), timeout=8.0)
+            except asyncio.TimeoutError:
+                pass
+            for i in range(n):
+                rt.kill(i)
+
+        asyncio.run(scenario())
+        assert not rt.crashed
+        assert int(rt.states()[0]["acked"]) >= 5
+        assert calls["single"] > 0      # the bypass path actually ran
+
     def test_kill_purges_queued_events(self):
         # a killed process's pending events must never fire: events
         # already enqueued for the drain are purged by kill(), so a
