@@ -233,3 +233,101 @@ class TestMultiEntryAE:
     def test_batched_replay_stable(self):
         assert self._rt(4, tlimit=sec(2)).check_determinism(
             seed=77, max_steps=5000)
+
+
+class TestInvariantForms:
+    """The two State-Machine-Safety forms (raft_invariant window_slides):
+    pairwise [N,N,L+1] (sound for any snap_len) vs commit-sorted adjacent
+    chain (O(N*L), valid ONLY when the window never slides). These tests
+    pin (a) their equivalence on never-sliding states — the condition the
+    static gate encodes — and (b) the compaction soundness gap that makes
+    the gate necessary (the code-review scenario, verbatim)."""
+
+    def test_forms_agree_on_no_compaction_chaos_states(self):
+        # a wrong-quorum cluster manufactures REAL violations (two
+        # leaders, divergent committed prefixes); crashed lanes freeze at
+        # their first violating state, so the final batch holds a mix of
+        # clean and violating configurations — both forms must agree on
+        # every lane, bad flag AND code
+        import jax
+
+        sc = Scenario()
+        sc.at(ms(400)).partition([0, 1])
+        sc.at(ms(900)).heal()
+        cfg = SimConfig(n_nodes=5, event_capacity=96, time_limit=sec(3))
+        rt = make_raft_runtime(5, log_capacity=16, n_cmds=6,
+                               majority_override=2, scenario=sc, cfg=cfg)
+        st, _ = rt.run(rt.init_batch(np.arange(64)), 8000)
+        assert bool(np.asarray(st.crashed).any())   # violations happened
+        inv_pair = R.raft_invariant(5, 16, window_slides=True)
+        inv_adj = R.raft_invariant(5, 16, window_slides=False)
+        bad_p, code_p = jax.vmap(inv_pair)(st)
+        bad_a, code_a = jax.vmap(inv_adj)(st)
+        np.testing.assert_array_equal(np.asarray(bad_p), np.asarray(bad_a))
+        np.testing.assert_array_equal(
+            np.asarray(code_p)[np.asarray(bad_p)],
+            np.asarray(code_a)[np.asarray(bad_a)])
+
+    def _slid_window_divergence_state(self):
+        """Three peers, committed-prefix divergence, one node compacted
+        past another's commit: A(ec=5, sl=0) diverges from the true
+        history at index 2; B(ec=10, sl=8) compacted to 8; C(ec=20,
+        sl=0) holds the true history. Pairwise checks (A,C) at 5 and
+        fires; the adjacent chain's A->B link is voided (5 < sl_B=8), so
+        transitivity breaks and it misses the divergence."""
+        N, L = 3, 32
+        rt = make_raft_runtime(N, log_capacity=L, n_cmds=0)
+        s = rt._template
+        ns = {k: np.asarray(v).copy() for k, v in s.node_state.items()}
+        true_cmds = np.arange(1, 21, dtype=np.int32)        # 1..20
+        term = 1
+
+        def chain_digest(cmds):         # digest of a compacted prefix
+            powP = np.asarray(R._pow_table(len(cmds)), np.int64)
+            dig = 0
+            h = [int(R.entry_hash(jnp.asarray(term), [jnp.asarray(int(c))]))
+                 for c in cmds]
+            n = len(cmds)
+            for k in range(n):
+                dig = (dig + h[k] * int(powP[n - 1 - k])) % (1 << 32)
+            return np.int32(dig - (1 << 32) if dig >= (1 << 31) else dig)
+
+        for i in range(N):
+            ns["role"][i] = R.FOLLOWER
+            ns["term"][i] = term
+        # A: full history from 0, len 5, commit 5, DIVERGENT at index 2
+        a_cmds = true_cmds[:5].copy()
+        a_cmds[2] = 999
+        ns["snap_len"][0], ns["snap_digest"][0] = 0, 0
+        ns["log_len"][0], ns["commit"][0] = 5, 5
+        ns["log_term"][0, :5] = term
+        ns["log_cmd"][0, :5] = a_cmds
+        # B: compacted to 8 over the TRUE history, entries 8..9 live
+        ns["snap_len"][1] = 8
+        ns["snap_term"][1] = term
+        ns["snap_digest"][1] = chain_digest(true_cmds[:8])
+        ns["log_len"][1], ns["commit"][1] = 10, 10
+        ns["log_term"][1, :2] = term
+        ns["log_cmd"][1, :2] = true_cmds[8:10]
+        # C: full true history, len 20, commit 20
+        ns["snap_len"][2], ns["snap_digest"][2] = 0, 0
+        ns["log_len"][2], ns["commit"][2] = 20, 20
+        ns["log_term"][2, :20] = term
+        ns["log_cmd"][2, :20] = true_cmds
+        return s.replace(node_state={k: jnp.asarray(v)
+                                     for k, v in ns.items()}), N, L
+
+    def test_pairwise_catches_slid_window_divergence(self):
+        st, N, L = self._slid_window_divergence_state()
+        bad, code = R.raft_invariant(N, L, window_slides=True)(st)
+        assert bool(bad)
+        assert int(code) == R.CRASH_LOG_MISMATCH
+
+    def test_adjacent_form_misses_it_hence_the_gate(self):
+        # NOT a desired property — this documents the exact coverage gap
+        # that forbids the cheap form whenever the window can slide. If
+        # this test ever FAILS (the adjacent form starts catching it),
+        # the static gate in raft_invariant can be revisited.
+        st, N, L = self._slid_window_divergence_state()
+        bad, _ = R.raft_invariant(N, L, window_slides=False)(st)
+        assert not bool(bad)
