@@ -650,6 +650,9 @@ def main():
         print(_events_per_sec(1, CPU_STEPS, WARM))
         return
     if "--native-baseline" in sys.argv:
+        # needs no device; forcing CPU keeps the madsim_tpu import from
+        # wedging against a dead tunnel
+        _force_cpu_inprocess()
         print(json.dumps(_native_baseline_eps() or {"error": "no toolchain"}))
         return
 
@@ -661,14 +664,19 @@ def main():
     cpu_eps = float(out.stdout.strip().splitlines()[-1])
     print(f"cpu single-seed baseline: {cpu_eps:,.0f} events/s",
           file=sys.stderr)
-    native = _native_baseline_eps()
-    if native:
-        print(f"native single-seed baseline: "
-              f"{native['events_per_sec']:,.0f} events/s", file=sys.stderr)
 
     # No chip answering means batched-on-CPU, so the round still records
     # a real speedup number instead of a traceback.
     on_tpu = _preflight_or_cpu("bench")
+
+    # AFTER the preflight settled the platform: _native_baseline_eps
+    # imports madsim_tpu, and importing the package before the platform
+    # decision wedges this process against a dead tunnel (the same hang
+    # _preflight_or_cpu exists to prevent)
+    native = _native_baseline_eps()
+    if native:
+        print(f"native single-seed baseline: "
+              f"{native['events_per_sec']:,.0f} events/s", file=sys.stderr)
 
     batched_eps = _batched_eps_with_retry("tpu" if on_tpu else "cpu")
 
