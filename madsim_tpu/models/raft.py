@@ -572,6 +572,17 @@ class Raft(Program):
         ctx.state = st
 
 
+def window_slides_for(raft_kw) -> bool:
+    """The `raft_invariant(window_slides=...)` gate for runtime builders,
+    in ONE place next to the rule's definition: the log window can slide
+    iff compaction is enabled (`compact_threshold > 0`) — without a
+    compacting leader, no InstallSnapshot can arrive to slide it either.
+    Builders that support compaction pass their raft kwargs here; any
+    new knob that can raise snap_len must be added HERE, not at the
+    call sites."""
+    return bool(raft_kw.get("compact_threshold", 0))
+
+
 def raft_invariant(n_nodes: int, log_capacity: int = 32, fields=("cmd",),
                    raft_nodes=None, window_slides: bool = True):
     """Global safety checks, evaluated after every event.
@@ -702,8 +713,5 @@ def make_raft_runtime(n_nodes=5, log_capacity=32, n_cmds=8,
                    scenario=scenario,
                    invariant=raft_invariant(
                        n_nodes, log_capacity,
-                       # no compaction => snap_len pinned at 0 => the cheap
-                       # adjacent-chain form is coverage-equivalent
-                       window_slides=bool(
-                           raft_kw.get("compact_threshold", 0))),
+                       window_slides=window_slides_for(raft_kw)),
                    persist=persist_spec())

@@ -364,11 +364,7 @@ def make_kv_runtime(n_raft=5, n_clients=3, n_keys=4, n_ops=12,
                  node_prog=node_prog, scenario=scenario,
                  invariant=R.raft_invariant(
                      n, log_capacity, KV_FIELDS, peer_mask,
-                     # compaction slides the window; only a statically
-                     # pinned snap_len==0 build may use the cheap
-                     # adjacent-chain form (see raft_invariant docstring)
-                     window_slides=bool(
-                         raft_kw.get("compact_threshold", 0))),
+                     window_slides=R.window_slides_for(raft_kw)),
                  persist=kv_persist_spec(),
                  halt_when=(all_clients_done(n_raft, n_ops)
                             if halt_when_all_done else None))

@@ -601,7 +601,9 @@ def make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2, n_keys=8,
         base = rc + g * rg
         masks.append((np.arange(n) >= base) & (np.arange(n) < base + rg))
     inv = compose_invariants(
-        *[R.raft_invariant(n, log_capacity, FIELDS, m) for m in masks])
+        *[R.raft_invariant(n, log_capacity, FIELDS, m,
+                           window_slides=R.window_slides_for(kw))
+          for m in masks])
     clients_base = rc + n_groups * rg
     return Runtime(cfg, progs,
                    shard_state_spec(n, log_capacity, n_groups=n_groups,
