@@ -9,6 +9,10 @@ cd /root/repo
 for i in $(seq 1 200); do
   if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform not in ('cpu','none')" 2>/dev/null; then
     echo "$(date -u +%H:%M:%S) tunnel alive, running bench chain" >> tpu_watch.log
+    # a wedge verdict cached by a recent bench/example probe (<=4 min
+    # TTL) would make the chain's own preflights fall back to CPU on
+    # a freshly revived tunnel — clear it now that we KNOW it answers
+    rm -f /tmp/madsim_tpu_tunnel_dead.* 2>/dev/null
     # commit after EVERY stage: if the tunnel wedges mid-chain (the bench
     # runs deliberately have no timeout), the stages already captured
     # survive as commits instead of dying with the stuck watcher
