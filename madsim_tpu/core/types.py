@@ -121,9 +121,7 @@ class NetConfig:
             packet_loss_rate = 0.1
             send_latency = "1ms..10ms"   # or send_latency_min/max in ticks
         """
-        import tomllib
-
-        data = tomllib.loads(text).get("net", {})
+        data = _toml_loads(text).get("net", {})
         kw = {}
         if "packet_loss_rate" in data:
             kw["packet_loss_rate"] = float(data["packet_loss_rate"])
@@ -138,6 +136,50 @@ class NetConfig:
         if "op_jitter_max" in data:  # ticks or a "5us"-style duration
             kw["op_jitter_max"] = _parse_dur(str(data["op_jitter_max"]))
         return NetConfig(**kw)
+
+
+def _toml_loads(text: str) -> dict:
+    """stdlib tomllib when available (3.11+); otherwise a fallback parser
+    for the flat `[section]` / `key = value` subset the config shape
+    actually uses (this image ships 3.10 and no tomli — the container's
+    packages are fixed, so the knob must not require one)."""
+    try:
+        import tomllib
+        return tomllib.loads(text)
+    except ImportError:
+        pass
+    out: dict = {}
+    section = out
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        header = line.split("#", 1)[0].strip()   # header may carry a comment
+        if header.startswith("[") and header.endswith("]"):
+            section = out.setdefault(header[1:-1].strip(), {})
+            continue
+        key, _, val = line.partition("=")
+        val = val.strip()
+        if not _:
+            raise ValueError(f"unparseable config line: {raw!r}")
+        try:
+            if val[:1] in ('"', "'"):           # quoted string (anything
+                q = val[0]                       # past the close quote —
+                val = val[1:val.index(q, 1)]     # e.g. a comment — ignored)
+            else:
+                val = val.split("#", 1)[0].strip()  # bare value, no comment
+                if val in ("true", "false"):
+                    val = val == "true"
+                else:
+                    try:
+                        val = int(val)
+                    except ValueError:
+                        val = float(val)
+        except ValueError as e:
+            raise ValueError(f"unparseable config line: {raw!r} ({e})") \
+                from None
+        section[key.strip()] = val
+    return out
 
 
 def _parse_dur(s: str) -> int:

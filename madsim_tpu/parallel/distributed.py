@@ -66,6 +66,31 @@ def host_seed_slice(total_seeds: int, base_seed: int = 0) -> np.ndarray:
                      dtype=np.uint32)
 
 
+def run_fused_sharded(rt, seeds: np.ndarray, max_steps: int,
+                      chunk: int = 512):
+    """Whole-sweep-on-device at multi-process scale: assemble the global
+    sharded batch (this process contributes its `host_seed_slice`) and
+    drive it with the fused while_loop runner. The loop predicate's
+    `halted.all()` lowers to a cross-chip all-reduce (ICI within a host,
+    DCN between hosts) each chunk — no host touches the sweep until the
+    caller reads results.
+
+    This is the sharded complement to `run_compacting_sharded`: the
+    compacting path re-packs lanes through host numpy and is therefore
+    per-host by construction (Runtime.run_compacting refuses
+    non-addressable batches); the fused path is pure SPMD, so the
+    non-addressable global state goes straight through `run_fused` —
+    which, unlike the chunked `run()`, never calls `bool(halted.all())`
+    on the host and so never forces a cross-process sync point in
+    Python. Choose fused when lanes halt together (no compaction win),
+    compacting when the halt distribution is long-tailed.
+
+    `seeds` is this process's LOCAL slice (from `host_seed_slice`).
+    Returns the global sharded final state.
+    """
+    return rt.run_fused(shard_global(rt, seeds), max_steps, chunk)
+
+
 def run_compacting_sharded(rt, seeds: np.ndarray, max_steps: int,
                            chunk: int = 512, compact_when: float = 0.5,
                            min_batch: int = 256):

@@ -12,6 +12,15 @@ schedule space (as the hash observes it) is saturated.
 Crashes don't abort the sweep: every distinct crash code is collected
 with its first seed (the repro handle), because a fuzzing run wants the
 full harvest, not the first kill.
+
+Pipelining (the Podracer discipline, PAPERS.md): each round is one fused
+`run_fused` dispatch plus an on-device coverage reduction, both queued
+asynchronously — so round r+1's init+run is DISPATCHED before the host
+blocks on round r's digest, and the host-side dedup/crash-harvest of
+round r overlaps round r+1's device compute. The device only idles when
+the sweep is genuinely done. `pipeline=False` restores the serial
+round-by-round order for debugging (identical results — pipelining only
+reorders host work, never device math).
 """
 
 from __future__ import annotations
@@ -22,12 +31,29 @@ from . import stats
 
 
 def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
-            dry_rounds: int = 2, base_seed: int = 0, chunk: int = 512):
+            dry_rounds: int = 2, base_seed: int = 0, chunk: int = 512,
+            pipeline: bool = True, fused: bool = True):
     """Sweep seed batches until `dry_rounds` consecutive rounds add no
     new distinct schedule (or `max_rounds` is hit).
 
+    Args beyond the sweep shape:
+      pipeline: dispatch round r+1 before blocking on round r's results
+        (double-buffered; JAX async dispatch overlaps host dedup with
+        device compute). When the dry-stop fires, the one speculatively
+        dispatched round is discarded — its device work is wasted, the
+        price of never idling the device on the common (non-dry) path.
+        Effective only with fused=True (the chunked runner blocks per
+        chunk, so speculation there would be pure waste; it is gated
+        off automatically).
+      fused: drive each round with `Runtime.run_fused` (one XLA dispatch
+        per round, on-device halt test) instead of the chunked `run()`.
+        The chunked runner syncs to the host every `chunk` steps, which
+        serializes rounds regardless of `pipeline`; fused is what makes
+        the pipeline actually overlap.
+
     Returns a dict:
-      seeds_run            total seeds executed
+      seeds_run            total seeds executed (harvested rounds only —
+                           a discarded speculative round is not counted)
       rounds               rounds executed
       distinct_schedules   cumulative distinct sched_hash values
       new_per_round        schedules first seen in each round (the
@@ -37,29 +63,53 @@ def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
       crash_first_seed_by_code   {crash_code: first seed} repro handles
       crashes              total crashed trajectories
     """
+    def launch(r):
+        """Dispatch one round's full device program without blocking:
+        init + run + coverage reduction are all queued async."""
+        seeds = np.arange(base_seed + r * batch,
+                          base_seed + (r + 1) * batch, dtype=np.uint32)
+        if fused:
+            state = rt.run_fused(rt.init_batch(seeds), max_steps, chunk)
+        else:
+            state, _ = rt.run(rt.init_batch(seeds), max_steps, chunk)
+        pairs, n = stats.coverage_digest(state)
+        return seeds, state, pairs, n
+
+    def harvest(launched):
+        """Block on one round's results. Transfers the O(distinct) hash
+        digest plus the [B] crash lanes — never the full [B] hash array."""
+        seeds, state, pairs, n = launched
+        hashes = stats.digest_hashes(pairs, n)
+        return (seeds, hashes, np.asarray(state.crashed),
+                np.asarray(state.crash_code))
+
     seen: set[int] = set()
     crashes: dict[int, int] = {}
     n_crashed = 0
     new_per_round: list[int] = []
     dry = 0
     rounds = 0
+    # speculation requires the fused runner: the chunked run() blocks on
+    # every chunk's host sync, so a "speculative" chunked round would run
+    # to completion inline — all waste, no overlap
+    speculate = pipeline and fused
+    pending = launch(0) if max_rounds > 0 else None
     for r in range(max_rounds):
-        seeds = np.arange(base_seed + r * batch, base_seed + (r + 1) * batch,
-                          dtype=np.uint32)
-        state, _ = rt.run(rt.init_batch(seeds), max_steps, chunk)
-        hashes = stats.sched_hash_u64(state).tolist()
-        crashed = np.asarray(state.crashed)
-        codes = np.asarray(state.crash_code)
+        nxt = (launch(r + 1) if speculate and r + 1 < max_rounds else None)
+        seeds, hashes, crashed, codes = harvest(pending)
         for i in np.nonzero(crashed)[0]:
             crashes.setdefault(int(codes[i]), int(seeds[i]))
         n_crashed += int(crashed.sum())
-        new = len(set(hashes) - seen)
-        seen.update(hashes)
+        fresh = set(hashes.tolist()) - seen
+        new = len(fresh)
+        seen |= fresh
         new_per_round.append(new)
         rounds += 1
         dry = dry + 1 if new == 0 else 0
         if dry >= dry_rounds:
             break
+        pending = nxt if nxt is not None else (
+            launch(r + 1) if r + 1 < max_rounds else None)
     return dict(
         seeds_run=rounds * batch,
         rounds=rounds,

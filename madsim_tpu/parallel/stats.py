@@ -4,12 +4,65 @@ The reference exposes per-run Stat{msg_count} (network.rs:82-85) and prints
 a repro line on failure. A batched runtime wants fleet-level reductions
 (SURVEY §7 L6: first-crash seed, coverage stats): crash histograms by code,
 schedule-space coverage (distinct terminal fingerprints), throughput
-figures. All cheap host-side numpy over the final device state.
+figures. Two tiers: cheap host-side numpy over transferred final state
+(crash histograms, representatives), and — for the coverage question the
+pipelined explore() asks every round — an ON-DEVICE distinct-schedule
+reduction (`coverage_digest`) that ships only the O(distinct) summary
+across the host boundary, never the full [B] hash array.
 """
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
+
+
+@jax.jit
+def _coverage_digest(sched_hash):
+    """Device-side distinct-schedule reduction over the two uint32
+    sched_hash lanes: lexicographic sort (two stable argsorts — uint64 is
+    unavailable without x64), adjacent-compare for first occurrences, and
+    a stable compaction of the distinct pairs to the front.
+
+    Returns (pairs [B, 2] uint32 with the `n` distinct rows packed first
+    in sorted order, n int32). Everything stays on-device; the caller
+    transfers only the packed prefix — O(distinct) uint64s across the
+    host boundary per round instead of the full [B] hash array (the
+    TPU-Ising "ship summaries, not samples" discipline, PAPERS.md)."""
+    h0, h1 = sched_hash[:, 0], sched_hash[:, 1]
+    order = jnp.argsort(h1, stable=True)          # minor key first,
+    order = order[jnp.argsort(h0[order], stable=True)]   # then major
+    h0s, h1s = h0[order], h1[order]
+    first = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (h0s[1:] != h0s[:-1]) | (h1s[1:] != h1s[:-1])])
+    pack = jnp.argsort(~first, stable=True)       # distinct rows first
+    return jnp.stack([h0s[pack], h1s[pack]], axis=1), first.sum(
+        dtype=jnp.int32)
+
+
+def coverage_digest(state):
+    """Launch the device-side coverage reduction; returns DEVICE arrays
+    (pairs, n) without blocking — JAX async dispatch means the caller can
+    queue more work (the pipelined explore()) before forcing either."""
+    return _coverage_digest(state.sched_hash)
+
+
+def digest_hashes(pairs, n) -> np.ndarray:
+    """Materialize a coverage digest host-side: transfers only the `n`
+    distinct rows (a device slice, not the full [B] array) and combines
+    the lanes into uint64 — same value domain as `sched_hash_u64`, but
+    already deduplicated and sorted."""
+    top = np.asarray(pairs[:int(n)]).astype(np.uint64)
+    return (top[:, 0] << np.uint64(32)) | top[:, 1]
+
+
+def distinct_schedules(state) -> int:
+    """Distinct dispatch-order count via the on-device reduction; only
+    one int32 crosses the host boundary."""
+    _, n = coverage_digest(state)
+    return int(n)
 
 
 def sched_hash_u64(state) -> np.ndarray:
@@ -82,7 +135,9 @@ def summarize(rt, state, seeds=None) -> dict:
         # Coarser than distinct_outcomes (fingerprints cover sched_hash
         # plus all payload/state differences) but it answers the coverage
         # question directly: how many INTERLEAVINGS did the batch explore,
-        # independent of what values flowed through them.
-        distinct_schedules=int(len(np.unique(sched_hash_u64(state)))),
+        # independent of what values flowed through them. Counted by the
+        # on-device reduction: one int32 crosses the host boundary, not
+        # the [B] hash array.
+        distinct_schedules=distinct_schedules(state),
         oops=int((np.asarray(state.oops) != 0).sum()),
     )

@@ -176,10 +176,77 @@ class Runtime:
 
         return jax.jit(run, static_argnums=1, donate_argnums=0)
 
+    @functools.cached_property
+    def _fused_runner(self):
+        """Whole-sweep-in-one-dispatch runner: a jitted `lax.while_loop`
+        whose body is the same vmapped-scan chunk as `_run_chunk` and whose
+        predicate — `(chunks_done < n_chunks) & ~halted.all()` — evaluates
+        ON-DEVICE. The chunked `run()` pays a device→host round-trip per
+        chunk for `bool(state.halted.all())`; here the whole sweep is one
+        XLA dispatch with donated buffers, so the host thread returns
+        immediately (async dispatch) and the device never idles between
+        chunks. Under a sharded batch the predicate's `all()` lowers to a
+        cross-chip all-reduce — no host involvement there either.
+
+        `n_chunks` is a traced operand (no recompile per sweep length);
+        `chunk_len` is static (scan length must be)."""
+        vstep = jax.vmap(self._step)
+
+        def run(state: SimState, n_chunks, chunk_len: int):
+            def chunk_body(s, _):
+                s, _ = vstep(s)
+                return s, None
+
+            def cond(carry):
+                i, s = carry
+                return (i < n_chunks) & ~s.halted.all()
+
+            def body(carry):
+                i, s = carry
+                s, _ = jax.lax.scan(chunk_body, s, length=chunk_len)
+                return i + 1, s
+
+            _, final = jax.lax.while_loop(
+                cond, body, (jnp.asarray(0, jnp.int32), state))
+            return final
+
+        return jax.jit(run, static_argnums=2, donate_argnums=0)
+
+    def run_fused(self, state: SimState, max_steps: int,
+                  chunk: int = 512) -> SimState:
+        """`run()` without the per-chunk host sync: advance until every
+        trajectory halts or ~max_steps events each (rounded up to a chunk
+        multiple), as ONE XLA dispatch (see `_fused_runner`).
+
+        Bitwise-equivalent to `run(state, max_steps, chunk)`: the loop
+        applies the identical vmapped-scan chunk body under the identical
+        continue condition, so final states (and fingerprints) match the
+        chunked runner exactly (tests/test_fused.py asserts this).
+
+        Trade-offs vs `run()`: no `collect_events` (a while_loop cannot
+        stack per-step records; use `run()`/`run_single` for traces) and
+        no between-chunk host inspection (use `run()` for interactive
+        `inject`/`kill` supervision). Input buffers are DONATED — do not
+        reuse `state` after calling. Works on sharded, non-addressable
+        batches (it is pure SPMD), unlike `run_compacting`.
+        """
+        n_chunks = -(-max_steps // chunk)
+        return self._fused_runner(state, jnp.asarray(n_chunks, jnp.int32),
+                                  chunk)
+
     def run(self, state: SimState, max_steps: int, chunk: int = 512,
             collect_events: bool = False):
         """Advance until every trajectory halts or ~max_steps events each
         (rounded up to a chunk multiple). Returns (state, events|None).
+
+        Overshoot contract (`collect_events=True`): chunks are always run
+        in full and the loop continues while ANY lane is live, so a lane
+        that halts early keeps emitting records for every remaining chunk
+        of the sweep (not just its own chunk's tail — a lane halting in
+        chunk 1 of 8 gets ~7 chunks of frozen records). Those records
+        carry `fired=False` — trace consumers must filter on `fired`,
+        never on step count (tests/test_fused.py asserts the frozen-lane
+        tail is present and `fired=False`).
         """
         # always run full chunks: halted trajectories are frozen by the
         # live-mask gating inside the step, so overshooting max_steps is free

@@ -21,6 +21,9 @@ case "$tier" in
   fast)
     python -m pytest tests/ -q -m "not realworld and not slow"
     python -m pytest tests/ -q -m "realworld and not slow"
+    # seconds-scale fused-runner smoke: run_fused must stay bitwise-equal
+    # to the chunked runner and the pipelined explore() must round-trip
+    python bench.py --fused-smoke
     ;;
   full)
     python -m pytest tests/ -q

@@ -1,12 +1,18 @@
 """Profile the flagship bench step on the live device and print the top
 HLO ops by self-time.
 
-Usage: python scripts/profile_step.py [steps]
+Usage: python scripts/profile_step.py [steps] [--fused]
 Captures a jax.profiler device trace of one timed chunk (default 64
 steps, B=4096 — the bench configuration) and aggregates the device
 plane's XLA-op events by name. This is the method that produced the
 round-2 findings in DESIGN.md §5 (gather serialization); keep using it
 after engine changes — CPU microbenchmarks mislead (scripts/micro_gather.py).
+
+--fused profiles `Runtime.run_fused` (the while_loop early-exit runner)
+over the same step budget instead of one chunked dispatch — the trace
+then shows the whole sweep as ONE device program, with no host gap
+between chunks; compare against the default mode to see what the
+per-chunk sync actually costs on the live chip.
 """
 import collections
 import glob
@@ -18,13 +24,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    fused = "--fused" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    steps = int(args[0]) if args else 64
     import numpy as np
     import jax
     from bench import _make_runtime
 
     rt = _make_runtime()
-    runner = rt._run_chunk[False]
+    if fused:
+        # whole sweep = one dispatch (chunk sized to the step budget so
+        # the while_loop body matches the chunked trace's scan length)
+        def runner(state, n):
+            return rt.run_fused(state, n, chunk=n), None
+    else:
+        runner = rt._run_chunk[False]
     state = rt.init_batch(np.arange(4096))
     state, _ = runner(state, steps)          # compile + warm
     jax.block_until_ready(state.now)

@@ -88,36 +88,64 @@ print(f"RESULT pid={{pid}} fp_match={{bool((fp_plain == fp_comp).all())}} "
 """
 
 
+# this jaxlib's CPU backend may not implement cross-process collectives
+# at all ("Multiprocess computations aren't implemented on the CPU
+# backend") — an environment capability, not a code defect. The workers
+# run to the first collective either way, so the marker in their output
+# distinguishes "backend can't" (skip, precisely) from a real failure.
+MULTIPROC_UNSUPPORTED = "Multiprocess computations aren't implemented"
+_multiproc_broken = False    # memo: once one worker pair proves the
+                             # backend can't, later tests skip instantly
+
+
+def _run_two_workers(tmp_path, template, name):
+    """Launch the two-process worker script on a loopback coordinator and
+    return the RESULT lines; skip if the backend lacks multiprocess CPU
+    support, fail on anything else."""
+    import socket
+
+    global _multiproc_broken
+    if _multiproc_broken:
+        pytest.skip("this jaxlib's CPU backend lacks multiprocess "
+                    "collectives (established earlier in this session)")
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:  # ephemeral port: no CI collisions
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    f = tmp_path / name
+    f.write_text(template.format(root=root, port=port))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS",)}
+    procs = [subprocess.Popen([sys.executable, str(f), str(i)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              env=env)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out")
+        outs.append(out)
+    results = [l for o in outs for l in o.splitlines()
+               if l.startswith("RESULT")]
+    if len(results) != 2:
+        if any(MULTIPROC_UNSUPPORTED in o for o in outs):
+            _multiproc_broken = True
+            pytest.skip("this jaxlib's CPU backend lacks multiprocess "
+                        "collectives; the DCN path needs real multi-host "
+                        "(or a jaxlib with CPU cross-process support)")
+        pytest.fail(f"workers failed:\n{outs[0]}\n{outs[1]}")
+    return results
+
+
 class TestDistributed:
     def test_two_process_sweep(self, tmp_path):
-        import socket
-
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        with socket.socket() as s:  # ephemeral port: no CI collisions
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        script = WORKER.format(root=root, port=port)
-        f = tmp_path / "worker.py"
-        f.write_text(script)
-        env = {k: v for k, v in os.environ.items()
-               if k not in ("PALLAS_AXON_POOL_IPS",)}
-        procs = [subprocess.Popen([sys.executable, str(f), str(i)],
-                                  stdout=subprocess.PIPE,
-                                  stderr=subprocess.STDOUT, text=True,
-                                  env=env)
-                 for i in range(2)]
-        outs = []
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=240)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                pytest.fail("distributed worker timed out")
-            outs.append(out)
-        results = [l for o in outs for l in o.splitlines()
-                   if l.startswith("RESULT")]
-        assert len(results) == 2, f"workers failed:\n{outs[0]}\n{outs[1]}"
+        results = _run_two_workers(tmp_path, WORKER, "worker.py")
         # both processes see the same GLOBAL reduction over 32 seeds
         acked = [int(r.split("total_acked=")[1].split()[0]) for r in results]
         halted = [r.split("halted=")[1].strip() for r in results]
@@ -129,33 +157,7 @@ class TestDistributed:
         # process compacts its local slice; per-lane state fingerprints
         # must be bit-identical to the non-compacting run, and the
         # assembled global state must report all-halted.
-        import socket
-
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        f = tmp_path / "worker2.py"
-        f.write_text(WORKER2.format(root=root, port=port))
-        env = {k: v for k, v in os.environ.items()
-               if k not in ("PALLAS_AXON_POOL_IPS",)}
-        procs = [subprocess.Popen([sys.executable, str(f), str(i)],
-                                  stdout=subprocess.PIPE,
-                                  stderr=subprocess.STDOUT, text=True,
-                                  env=env)
-                 for i in range(2)]
-        outs = []
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=240)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                pytest.fail("distributed worker timed out")
-            outs.append(out)
-        results = [l for o in outs for l in o.splitlines()
-                   if l.startswith("RESULT")]
-        assert len(results) == 2, f"workers failed:\n{outs[0]}\n{outs[1]}"
+        results = _run_two_workers(tmp_path, WORKER2, "worker2.py")
         for r in results:
             assert "fp_match=True" in r, r
             assert "halted=True" in r, r

@@ -1,0 +1,204 @@
+"""Fused on-device sweep engine: while_loop runner equivalence, the
+frozen-lane overshoot contract, the device-side coverage reduction, and
+the pipelined explore().
+
+The load-bearing property is bitwise determinism-equivalence: `run_fused`
+is the SAME vmapped-scan chunk body under the SAME continue condition as
+the chunked `run()`, merely with the `halted.all()` predicate evaluated
+on-device — so final states must match bit-for-bit, crashed lanes and
+all. Anything less means the fused path is a separate replay domain,
+which DESIGN §4 forbids.
+"""
+
+import numpy as np
+import pytest
+
+from madsim_tpu import Runtime, Scenario, SimConfig, NetConfig, ms, sec
+from madsim_tpu.models.pingpong import PingPong, state_spec
+from madsim_tpu.parallel import stats
+from madsim_tpu.parallel.explore import explore
+
+
+def _raft_rt(time_limit=sec(3)):
+    from madsim_tpu.models.raft import make_raft_runtime
+    cfg = SimConfig(n_nodes=5, event_capacity=128, time_limit=time_limit,
+                    net=NetConfig(packet_loss_rate=0.05,
+                                  send_latency_min=ms(1),
+                                  send_latency_max=ms(10)))
+    sc = Scenario()
+    sc.at(sec(1)).kill_random()
+    sc.at(sec(1) + ms(400)).restart_random()
+    return make_raft_runtime(5, 8, n_cmds=4, scenario=sc, cfg=cfg)
+
+
+def _fps_both(rt, seeds, max_steps, chunk):
+    """Fingerprints from the chunked and fused runners on fresh batches
+    (both runners donate their input buffers)."""
+    chunked, _ = rt.run(rt.init_batch(seeds), max_steps, chunk)
+    fused = rt.run_fused(rt.init_batch(seeds), max_steps, chunk)
+    return rt.fingerprints(chunked), rt.fingerprints(fused), fused
+
+
+class TestFusedEquivalence:
+    def test_raft_bitwise_match_64_seeds(self):
+        # chaos Raft, 64 seeds, a max_steps that is NOT a chunk multiple
+        # (both runners round up identically), short enough time limit
+        # that lanes halt mid-sweep at different steps
+        rt = _raft_rt()
+        seeds = np.arange(64, dtype=np.uint32)
+        f_chunked, f_fused, _ = _fps_both(rt, seeds, max_steps=1500,
+                                          chunk=256)
+        assert (f_chunked == f_fused).all()
+
+    def test_mid_sweep_crash_seed_matches(self):
+        # a known-red workload (WAL sync removed + power-fail chaos, the
+        # test_explore repro): some lanes crash mid-sweep while others
+        # run on — the fused predicate must keep stepping the live lanes
+        # and freeze the crashed ones exactly like the chunked runner
+        from madsim_tpu.models.wal_kv import make_wal_kv_runtime
+        sc = Scenario()
+        for t in range(6):
+            sc.at(ms(150) + ms(250) * t).kill(0)
+            sc.at(ms(210) + ms(250) * t).restart(0)
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                                 sync_wal=False, scenario=sc)
+        seeds = np.arange(64, dtype=np.uint32)
+        f_chunked, f_fused, fused = _fps_both(rt, seeds, max_steps=4096,
+                                              chunk=512)
+        crashed = np.asarray(fused.crashed)
+        assert crashed.any() and not crashed.all()  # genuinely mid-sweep
+        assert (f_chunked == f_fused).all()
+
+    @pytest.mark.slow
+    def test_shard_kv_bitwise_match_64_seeds(self):
+        from madsim_tpu.models.shard_kv import make_shard_runtime
+        cfg = SimConfig(n_nodes=11, event_capacity=160, payload_words=12,
+                        time_limit=sec(60),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(10)))
+        rt = make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2,
+                                n_ops=4, max_cfg=4, cfg=cfg)
+        seeds = np.arange(64, dtype=np.uint32)
+        f_chunked, f_fused, _ = _fps_both(rt, seeds, max_steps=4096,
+                                          chunk=512)
+        assert (f_chunked == f_fused).all()
+
+    def test_early_exit_stops_at_halt(self):
+        # all lanes halt quickly; the fused runner's on-device predicate
+        # must exit instead of burning the full max_steps budget. steps
+        # stays a per-lane count, so equivalence covers it too.
+        cfg = SimConfig(n_nodes=2, time_limit=sec(5),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(1)))
+        rt = Runtime(cfg, [PingPong(2, target=3)], state_spec())
+        seeds = np.arange(32, dtype=np.uint32)
+        f_chunked, f_fused, fused = _fps_both(rt, seeds, max_steps=100_000,
+                                              chunk=64)
+        assert bool(np.asarray(fused.halted).all())
+        assert (f_chunked == f_fused).all()
+
+
+class TestOvershootContract:
+    def test_overshoot_records_are_unfired(self):
+        # Runtime.run(collect_events=True) always runs full chunks, so a
+        # trajectory that halts mid-chunk (or a max_steps that is not a
+        # chunk multiple) emits frozen-lane records past its halt. The
+        # contract: those records carry fired=False — consumers filter on
+        # `fired`, never on step count.
+        cfg = SimConfig(n_nodes=2, time_limit=sec(5),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(1)))
+        rt = Runtime(cfg, [PingPong(2, target=3)], state_spec())
+        state, events = rt.run(rt.init_batch(np.arange(4, dtype=np.uint32)),
+                               max_steps=4096, chunk=256,
+                               collect_events=True)
+        assert bool(np.asarray(state.halted).all())
+        fired = np.asarray(events["fired"])        # [steps, B]
+        steps = np.asarray(state.steps)            # [B] true event counts
+        assert fired.shape[0] > int(steps.max())   # overshoot happened
+        for lane in range(fired.shape[1]):
+            n = int(steps[lane])
+            assert fired[:n, lane].all()           # real events fired
+            assert not fired[n:, lane].any()       # frozen tail is unfired
+        # per-lane fired count equals the engine's own step counter
+        assert (fired.sum(axis=0) == steps).all()
+
+
+class TestCoverageDigest:
+    def _state(self):
+        cfg = SimConfig(n_nodes=4, time_limit=sec(5),
+                        net=NetConfig(packet_loss_rate=0.1))
+        rt = Runtime(cfg, [PingPong(4, target=4)], state_spec())
+        state, _ = rt.run(rt.init_batch(np.arange(96, dtype=np.uint32)),
+                          max_steps=2000, chunk=256)
+        return state
+
+    def test_digest_matches_host_unique(self):
+        state = self._state()
+        pairs, n = stats.coverage_digest(state)
+        dev = stats.digest_hashes(pairs, n)
+        host = np.unique(stats.sched_hash_u64(state))
+        assert dev.dtype == np.uint64
+        assert (dev == host).all()                 # sorted + deduped match
+        assert stats.distinct_schedules(state) == len(host)
+
+    def test_summarize_uses_device_count(self):
+        state = self._state()
+        cfg = SimConfig(n_nodes=4, time_limit=sec(5),
+                        net=NetConfig(packet_loss_rate=0.1))
+        rt = Runtime(cfg, [PingPong(4, target=4)], state_spec())
+        out = stats.summarize(rt, state)
+        assert out["distinct_schedules"] == len(
+            np.unique(stats.sched_hash_u64(state)))
+
+
+class TestPipelinedExplore:
+    def _rt(self):
+        cfg = SimConfig(n_nodes=2, time_limit=sec(5),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(1)))
+        return Runtime(cfg, [PingPong(2, target=3)], state_spec())
+
+    def test_pipelined_equals_serial(self):
+        # pipelining reorders host work only; every reported number must
+        # be identical to the serial chunked path
+        rt = self._rt()
+        kw = dict(max_steps=2000, batch=32, max_rounds=8, dry_rounds=2)
+        piped = explore(rt, pipeline=True, fused=True, **kw)
+        serial = explore(rt, pipeline=False, fused=False, **kw)
+        assert piped == serial
+        assert piped["saturated"]
+
+    def test_crashes_harvested_through_fused_path(self):
+        from madsim_tpu.models import wal_kv
+        from madsim_tpu.models.wal_kv import make_wal_kv_runtime
+        sc = Scenario()
+        for t in range(6):
+            sc.at(ms(150) + ms(250) * t).kill(0)
+            sc.at(ms(210) + ms(250) * t).restart(0)
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                                 sync_wal=False, scenario=sc)
+        out = explore(rt, max_steps=60_000, batch=16, max_rounds=2,
+                      dry_rounds=2, pipeline=True, fused=True)
+        assert out["crashes"] > 0
+        assert wal_kv.CRASH_LOST_WRITE in out["crash_first_seed_by_code"]
+
+
+class TestFusedSharded:
+    def test_fused_runs_on_virtual_mesh(self):
+        # the conftest forces an 8-device CPU mesh; the fused while_loop
+        # (with its all-reduce predicate) must compile and run SPMD and
+        # agree bitwise with the unsharded run
+        from madsim_tpu.parallel.distributed import (host_seed_slice,
+                                                     run_fused_sharded)
+        rt = self._pingpong()
+        seeds = host_seed_slice(32)
+        sharded = run_fused_sharded(rt, seeds, max_steps=2000, chunk=256)
+        plain = rt.run_fused(rt.init_batch(seeds), 2000, 256)
+        assert (rt.fingerprints(sharded) == rt.fingerprints(plain)).all()
+
+    def _pingpong(self):
+        cfg = SimConfig(n_nodes=2, time_limit=sec(5),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(1)))
+        return Runtime(cfg, [PingPong(2, target=3)], state_spec())
