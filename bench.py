@@ -427,18 +427,21 @@ def _sched_ab_mode():
     print(json.dumps(out))
 
 
-def _make_light_runtime(n_nodes=2, loss=0.0):
+def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0):
     """A deliberately tiny workload (2-node ping-pong, C=16, P=2, stats
     off) for the fused A/B: per-step device compute is small, so the
     per-chunk host round-trip the chunked runner pays
     (`bool(halted.all())` + dispatch) is VISIBLE in the measurement
     instead of vanishing under model compute. The target is unreachable,
     so lanes never halt and both runners execute exactly the same step
-    count."""
+    count. The same smallness makes it the worst case for the flight
+    recorder's relative overhead (--mode obs_ab): the ring write is a
+    fixed per-step cost, so a tiny step magnifies it."""
     from madsim_tpu import Runtime, SimConfig, NetConfig, ms, sec
     from madsim_tpu.models.pingpong import PingPong, state_spec
     cfg = SimConfig(n_nodes=n_nodes, event_capacity=16, payload_words=2,
                     time_limit=sec(590), collect_stats=False,
+                    trace_cap=trace_cap,
                     net=NetConfig(packet_loss_rate=loss,
                                   send_latency_min=ms(1),
                                   send_latency_max=ms(4)))
@@ -548,6 +551,131 @@ def _fused_ab_mode():
         json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
                   indent=1)
     print(json.dumps(out))
+
+
+def _obs_ab_mode():
+    """--mode obs_ab: flight-recorder overhead A/B on the fused runner
+    (the path the ring exists for — a while_loop sweep had no other way
+    to come back with traces). Four builds of the same tiny workload,
+    identical trajectories by construction (the ring write consumes no
+    randomness):
+
+      off          trace_cap=0 — recorder compiled out (baseline)
+      ring_masked  trace_cap=64 compiled in, NO lanes sampled — the cost
+                   of carrying the ring state + masked-off writes
+      ring_8       trace_cap=64, 8 of B lanes sampled — the intended
+                   production shape (record a handful of lanes at full
+                   sweep scale)
+      ring_all     trace_cap=64, every lane samples — the ceiling
+
+    The acceptance bar is overhead_off-lane <= 5% at B=512: enabling the
+    recorder build without sampling must be ~free, so runtimes can ship
+    with trace_cap > 0 and flip lanes on per-sweep. min-of-reps per
+    cell; writes BENCH_obs_ab_<platform>.json next to this file."""
+    _preflight_or_cpu("--obs-ab")
+    import jax
+    platform = jax.devices()[0].platform
+    B, steps, chunk, reps = 512, 2048, 256, 9
+    variants = (("off", 0, None), ("ring_masked", 64, []),
+                ("ring_8", 64, list(range(8))), ("ring_all", 64, None))
+    out = {"metric": "obs_ab", "platform": platform, "batch": B,
+           "steps": steps, "chunk": chunk, "reps": reps, "trace_cap": 64,
+           "note": ("tiny 2-node workload = worst case for relative ring "
+                    "overhead (fixed per-step write vs tiny step); fused "
+                    "runner, lanes never halt, so every variant executes "
+                    "identical step counts; reps are INTERLEAVED "
+                    "round-robin so slow machine drift hits every variant "
+                    "equally, min-of-reps per variant. The three ring "
+                    "builds execute identical compute (a masked write "
+                    "runs whether the mask is on or off), so spread "
+                    "among them is the noise floor of the measurement"),
+           "variants": {}}
+    seeds = np.arange(B)
+    # one Runtime per distinct trace_cap: the three ring variants differ
+    # only in the init_batch sampling mask (a runtime argument), so they
+    # share one compiled fused program — the warmup pays two compiles
+    # (cap=0, cap=64), not four
+    by_cap = {cap: _make_light_runtime(trace_cap=cap)
+              for cap in {c for _, c, _ in variants}}
+    rts, kws = {}, {}
+    for name, cap, lanes in variants:
+        rts[name] = by_cap[cap]
+        kws[name] = ({} if cap == 0 or lanes is None
+                     else {"trace_lanes": lanes})
+    for cap, rt in by_cap.items():
+        jax.block_until_ready(
+            rt.run_fused(rt.init_batch(seeds), steps, chunk).now)
+    best = {name: float("inf") for name, _, _ in variants}
+    for _ in range(reps):
+        for name, _, _ in variants:
+            state = rts[name].init_batch(seeds, **kws[name])
+            jax.block_until_ready(state.now)
+            t0 = time.perf_counter()
+            final = rts[name].run_fused(state, steps, chunk)
+            jax.block_until_ready(final.now)
+            best[name] = min(best[name], time.perf_counter() - t0)
+    eps = {name: B * steps / b for name, b in best.items()}
+    for name, _, _ in variants:
+        out["variants"][name] = round(eps[name], 1)
+        print(f"--obs-ab: {name} {eps[name]:,.0f} seed-events/s",
+              file=sys.stderr)
+    for name in ("ring_masked", "ring_8", "ring_all"):
+        out[f"overhead_{name}"] = round(eps["off"] / eps[name] - 1, 4)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_obs_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _obs_smoke_mode():
+    """--obs-smoke: seconds-scale observability self-test for CI (wired
+    into scripts/ci.sh fast): a tiny traced sweep through the FUSED
+    runner must come back with a readable ring that exports as valid
+    Chrome-trace JSON, the collect_events exporter must agree with the
+    engine's own fired counts, and the sweep observer must see the run.
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import json as _json
+    import tempfile
+    from madsim_tpu.obs import (JsonlObserver, export_chrome_trace,
+                                ring_records)
+    t0 = time.perf_counter()
+    rt = _make_light_runtime(trace_cap=32)
+    seeds = np.arange(16)
+    fused = rt.run_fused(rt.init_batch(seeds, trace_lanes=[0, 5]), 192, 64)
+    # ring-enabled fused sweep must stay bitwise-equal to the chunked
+    # runner: fingerprints cover the non-trace state (the recorder is
+    # excluded from them by design), the ring columns compare directly
+    chunked, _ = rt.run(rt.init_batch(seeds, trace_lanes=[0, 5]), 192, 64)
+    assert (rt.fingerprints(fused) == rt.fingerprints(chunked)).all(), \
+        "traced fused runner diverged from chunked run()"
+    from madsim_tpu.core.state import TRACE_FIELDS
+    for f in TRACE_FIELDS:
+        assert (np.asarray(getattr(fused, f))
+                == np.asarray(getattr(chunked, f))).all(), f
+    recs = ring_records(fused, lane=5)
+    assert recs["total"] > 0 and len(recs["now"]) == min(recs["total"], 32)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "ring.json")
+        n = export_chrome_trace(p, state=fused, lane=5)
+        with open(p) as f:
+            doc = _json.load(f)          # must be valid JSON
+        assert n == len([e for e in doc["traceEvents"] if e["ph"] == "i"])
+        obs = JsonlObserver(os.path.join(d, "sweep.jsonl"))
+        state, events = rt.run(rt.init_batch(seeds), 192, 64,
+                               collect_events=True, observer=obs)
+        obs.close()
+        assert [r["kind"] for r in obs.records][-1] == "done"
+        p2 = os.path.join(d, "events.json")
+        n2 = export_chrome_trace(p2, events=events, b=3)
+        fired = int(np.asarray(events["fired"])[:, 3].sum())
+        assert n2 == fired, (n2, fired)
+    print(_json.dumps({
+        "metric": "obs_smoke", "platform": "cpu", "ok": True,
+        "ring_events": int(n), "exported_events": int(n2),
+        "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
 def _fused_smoke_mode():
@@ -799,11 +927,18 @@ def main():
         known = {"--fused-ab", "--fused-smoke", "--smoke", "--multihost",
                  "--shape-sweep", "--sweep", "--shardkv", "--minipg",
                  "--ministream", "--all", "--sched-ab", "--realworld",
-                 "--scaling", "--cpu-baseline", "--native-baseline"}
+                 "--scaling", "--cpu-baseline", "--native-baseline",
+                 "--obs-ab", "--obs-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
         sys.argv.append(flag)
+    if "--obs-ab" in sys.argv:
+        _obs_ab_mode()
+        return
+    if "--obs-smoke" in sys.argv:
+        _obs_smoke_mode()
+        return
     if "--fused-ab" in sys.argv:
         _fused_ab_mode()
         return

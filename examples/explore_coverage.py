@@ -26,7 +26,7 @@ ensure_safe_backend()   # CPU fallback iff a wedged TPU tunnel would hang us
 
 import numpy as np
 
-from madsim_tpu import Scenario, ms
+from madsim_tpu import ProgressObserver, Scenario, ms
 from madsim_tpu.models import wal_kv
 from madsim_tpu.models.wal_kv import make_wal_kv_runtime
 from madsim_tpu.parallel.explore import explore
@@ -43,7 +43,10 @@ def main():
     rt = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
                              sync_wal=False, scenario=sc)
 
-    out = explore(rt, max_steps=60_000, batch=batch, max_rounds=max_rounds)
+    # live per-round coverage growth on stderr while the sweep runs
+    # (obs/progress.py; swap in JsonlObserver to persist the records)
+    out = explore(rt, max_steps=60_000, batch=batch, max_rounds=max_rounds,
+                  observer=ProgressObserver())
     print(f"seeds run           : {out['seeds_run']}")
     print(f"distinct schedules  : {out['distinct_schedules']}")
     print(f"new per round       : {out['new_per_round']}")

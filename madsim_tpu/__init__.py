@@ -27,6 +27,13 @@ from .core.types import (
 )
 from .core.extension import Extension
 from .harness.determinism import find_divergence
+from .obs import (
+    JsonlObserver,
+    ProgressObserver,
+    SweepObserver,
+    export_chrome_trace,
+    ring_records,
+)
 from .harness.minimize import minimize_scenario
 from .harness.simtest import SimFailure, run_seeds, simtest
 from .parallel.explore import explore
@@ -43,4 +50,6 @@ __all__ = [
     "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
     "explore", "minimize_scenario", "summarize", "schedule_representatives",
     "find_divergence",
+    "SweepObserver", "JsonlObserver", "ProgressObserver", "ring_records",
+    "export_chrome_trace",
 ]

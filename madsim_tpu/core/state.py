@@ -26,6 +26,14 @@ from flax import struct
 
 from . import types as T
 
+# SimState fields owned by the flight recorder (cfg.trace_cap). One
+# schema constant so every consumer follows it automatically: excluded
+# from fingerprints (utils/hashing — observation only, never a replay
+# domain), read by obs/rings.py, compared explicitly in the
+# fused-vs-chunked equivalence tests and bench.py --obs-smoke.
+TRACE_FIELDS = ("trace_on", "trace_pos", "tr_now", "tr_step", "tr_kind",
+                "tr_node", "tr_src", "tr_tag")
+
 
 @struct.dataclass
 class SimState:
@@ -87,6 +95,26 @@ class SimState:
                             # (capacity-tuning aid: size event_capacity to
                             # the workload instead of guessing)
 
+    # --- flight-recorder ring (obs/rings.py; cfg.trace_cap) ---------------
+    # A fixed-capacity ring of the last trace_cap dispatched events for
+    # this lane, written inside the step — ring state RIDES IN SimState,
+    # so it survives `lax.while_loop` and the fused runner yields traces.
+    # trace_cap == 0 gives zero-size columns (compiled out). Columns are
+    # always int32: like the collect_events record schema, table_dtype
+    # must not leak into what observers read.
+    trace_on: jax.Array     # bool — lane-sampling gate (init_batch sets it;
+                            # lets a B=4096 sweep record e.g. 8 lanes)
+    trace_pos: jax.Array    # int32 — events recorded so far (monotonic;
+                            # the write slot is trace_pos % trace_cap, so
+                            # pos > cap means the ring wrapped)
+    tr_now: jax.Array       # int32[trace_cap] — virtual time of the event
+    tr_step: jax.Array      # int32[trace_cap] — step index (cross-ref with
+                            # collect_events row order / state_at)
+    tr_kind: jax.Array      # int32[trace_cap]
+    tr_node: jax.Array      # int32[trace_cap]
+    tr_src: jax.Array       # int32[trace_cap]
+    tr_tag: jax.Array       # int32[trace_cap]
+
     # --- extension state (plugin framework analog, plugin.rs) -------------
     ext: Any                # dict: extension name -> its state subtree
 
@@ -133,6 +161,16 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         msg_delivered=jnp.asarray(0, i32),
         msg_dropped=jnp.asarray(0, i32),
         ev_peak=jnp.asarray(0, i32),
+        # recorder default: every lane samples (when the ring is compiled
+        # in at all); init_batch(trace_lanes=...) narrows the mask
+        trace_on=jnp.asarray(cfg.trace_cap > 0),
+        trace_pos=jnp.asarray(0, i32),
+        tr_now=jnp.zeros((cfg.trace_cap,), i32),
+        tr_step=jnp.zeros((cfg.trace_cap,), i32),
+        tr_kind=jnp.zeros((cfg.trace_cap,), i32),
+        tr_node=jnp.zeros((cfg.trace_cap,), i32),
+        tr_src=jnp.zeros((cfg.trace_cap,), i32),
+        tr_tag=jnp.zeros((cfg.trace_cap,), i32),
         ext=ext_state if ext_state is not None else {},
     )
 

@@ -436,6 +436,36 @@ def make_step(
             tag=ev_tag.astype(jnp.int32), payload=ev_payload,
             fired=valid,
         )
+
+        # ---- flight-recorder ring (cfg.trace_cap; obs/rings.py) ----------
+        # The same record, written into a per-lane ring that lives in
+        # SimState — so it survives `lax.while_loop` and the fused runner
+        # is no longer blind. Only FIRED events of SAMPLED lanes write
+        # (the ring never holds frozen-lane records, unlike the
+        # collect_events stream, whose consumers must filter on `fired`).
+        # One one-hot row write per column, no randomness consumed: all
+        # non-trace state stays bit-identical across trace_cap settings.
+        if cfg.trace_cap > 0:
+            rec_w = record["fired"] & s.trace_on
+            slot = jnp.mod(s.trace_pos, cfg.trace_cap)
+            # one shared one-hot row mask for all six columns (the
+            # columns are [cap] vectors, so put_row's per-call reshape
+            # is unnecessary); the recorder's whole per-step cost is six
+            # [cap] selects + one masked increment
+            oh = sel.row_onehot(cfg.trace_cap, slot) & rec_w
+
+            def ringput(col, v):
+                return jnp.where(oh, v.astype(col.dtype), col)
+
+            s = s.replace(
+                tr_now=ringput(s.tr_now, record["now"]),
+                tr_step=ringput(s.tr_step, s.steps - 1),
+                tr_kind=ringput(s.tr_kind, record["kind"]),
+                tr_node=ringput(s.tr_node, record["node"]),
+                tr_src=ringput(s.tr_src, record["src"]),
+                tr_tag=ringput(s.tr_tag, record["tag"]),
+                trace_pos=s.trace_pos + rec_w.astype(jnp.int32),
+            )
         if extensions:
             new_ext = dict(s.ext)
             for e in extensions:

@@ -218,6 +218,18 @@ class SimConfig:
     # and fingerprints are BIT-IDENTICAL across this knob — a pure
     # bandwidth lever, not a replay domain.
     table_dtype: str = "int32"
+    # flight-recorder ring (obs/): rows per lane in the on-device trace
+    # ring. 0 (default) compiles the recorder out entirely — zero-size
+    # ring leaves, no write code in the step. > 0 keeps the last
+    # trace_cap dispatched events per SAMPLED lane (see
+    # Runtime.init_batch(trace_lanes=...)) resident in SimState, so the
+    # ring survives `lax.while_loop` and `run_fused` sweeps stop being
+    # blind. The write consumes no randomness and touches no other
+    # state, so all non-trace state is BIT-IDENTICAL across trace_cap
+    # settings — an observation lever like table_dtype, not a replay
+    # domain (the config hash does cover it, since the compiled program
+    # differs).
+    trace_cap: int = 0
     # emission-write lowering: how staged emissions land in the event
     # table. "onehot" = [E, C] one-hot masked-sum (VPU-friendly — the TPU
     # default); "scatter" = one XLA scatter per column at distinct slot
@@ -233,6 +245,7 @@ class SimConfig:
         assert self.n_nodes >= 1
         assert self.event_capacity >= 4
         assert self.payload_words >= 1
+        assert self.trace_cap >= 0
         assert self.table_dtype in ("int32", "int16")
         assert self.emission_write in ("auto", "onehot", "scatter")
         if self.table_dtype == "int16":

@@ -1,0 +1,32 @@
+"""Observability layer: flight-recorder rings, trace export, sweep metrics.
+
+madsim's debuggability contract is that a seed reproduces an execution you
+can *watch* (env_logger + MADSIM_TEST_SEED replay). The batched engine keeps
+that contract at three altitudes, each with a deliberate host-boundary cost
+(DESIGN.md "Observability discipline"):
+
+  * rings.py    — read the on-device flight-recorder ring (cfg.trace_cap):
+                  the last N events per sampled lane, resident in SimState,
+                  so even `run_fused` while_loop sweeps come back with
+                  traces. O(trace_cap) per sampled lane crosses the host
+                  boundary, once, at the end.
+  * trace.py    — export ring contents or `collect_events` streams as
+                  Chrome-trace/Perfetto JSON: one track per node,
+                  virtual-time timestamps, supervisor ops as instant
+                  events.
+  * metrics.py  — SweepObserver: a callback protocol hooked into the chunk
+                  boundaries run()/run_compacting()/explore() already pay
+                  for; JsonlObserver writes the records as JSONL.
+  * progress.py — ProgressObserver: live one-line sweep progress on a TTY.
+"""
+
+from .metrics import JsonlObserver, SweepObserver, TeeObserver
+from .progress import ProgressObserver
+from .rings import ring_records, sampled_lanes
+from .trace import export_chrome_trace, to_chrome_events
+
+__all__ = [
+    "SweepObserver", "JsonlObserver", "TeeObserver", "ProgressObserver",
+    "ring_records", "sampled_lanes", "to_chrome_events",
+    "export_chrome_trace",
+]

@@ -1,0 +1,98 @@
+"""Sweep metrics: the SweepObserver callback protocol + JSONL sink.
+
+Observers hook the host sync points the runners ALREADY pay for — the
+per-chunk `halted.all()` test in `run()`/`run_compacting()`, the
+per-round digest harvest in `explore()` — so attaching one adds no new
+device round-trips; the only extra cost is reading lanes the host was
+blocked on anyway. Record kinds (each a flat JSON-able dict carrying
+`kind`):
+
+  chunk    one scan chunk retired (run/run_compacting): steps_done,
+           lanes_halted, wall-clock lane_steps_per_sec
+  compact  run_compacting re-packed survivors: from_batch/to_batch/stashed
+  round    one explore() round harvested: new_schedules, distinct_total,
+           crashes — the per-round coverage growth off the existing
+           on-device digest
+  done     sweep finished: totals
+
+Dispatch is by attribute, so an observer overrides only the hooks it
+cares about; exceptions in observer code propagate (a metrics layer that
+silently eats its own bugs measures nothing).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import IO
+
+
+class SweepObserver:
+    """Base observer: every hook a no-op. Subclass and override."""
+
+    def on_chunk(self, rec: dict) -> None:
+        pass
+
+    def on_compact(self, rec: dict) -> None:
+        pass
+
+    def on_round(self, rec: dict) -> None:
+        pass
+
+    def on_done(self, rec: dict) -> None:
+        pass
+
+
+class JsonlObserver(SweepObserver):
+    """Write every record as one JSON line (the dashboard/ingest format).
+
+    `sink` is a path (opened for append; close() or use as a context
+    manager) or an open file-like object (caller owns its lifetime).
+    Floats are rounded — these are metrics, not measurements to diff.
+    """
+
+    def __init__(self, sink: str | IO[str]):
+        self._own = isinstance(sink, str)
+        self._f = open(sink, "a") if self._own else sink
+        self.records: list[dict] = []
+
+    def _emit(self, rec: dict) -> None:
+        rec = {k: (round(v, 3) if isinstance(v, float) else v)
+               for k, v in rec.items()}
+        self.records.append(rec)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    on_chunk = on_compact = on_round = on_done = _emit
+
+    def close(self) -> None:
+        if self._own:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class TeeObserver(SweepObserver):
+    """Fan one sweep out to several observers (e.g. JSONL + progress)."""
+
+    def __init__(self, *observers: SweepObserver):
+        self.observers = observers
+
+    def on_chunk(self, rec):
+        for o in self.observers:
+            o.on_chunk(rec)
+
+    def on_compact(self, rec):
+        for o in self.observers:
+            o.on_compact(rec)
+
+    def on_round(self, rec):
+        for o in self.observers:
+            o.on_round(rec)
+
+    def on_done(self, rec):
+        for o in self.observers:
+            o.on_done(rec)

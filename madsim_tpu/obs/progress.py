@@ -1,0 +1,80 @@
+"""Live sweep progress: one overwritten status line on a TTY.
+
+The operator-facing end of the metrics layer: where JsonlObserver feeds
+dashboards, ProgressObserver answers "is my 100k-seed sweep actually
+moving?" without attaching a profiler. Same hooks, same records — it just
+renders instead of persisting. Throttled to `min_interval` seconds so a
+fine-grained chunk loop doesn't spend its wall-clock printing.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def _rate(x: float) -> str:
+    for div, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if x >= div:
+            return f"{x / div:.1f}{suffix}"
+    return f"{x:.0f}"
+
+
+class ProgressObserver:
+    def __init__(self, stream=None, min_interval: float = 0.5):
+        self.stream = stream if stream is not None else sys.stderr
+        self.min_interval = min_interval
+        self._last = 0.0
+        self._line_open = False
+
+    def _show(self, text: str, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last < self.min_interval:
+            return
+        self._last = now
+        self.stream.write("\r\x1b[2K" + text if self._line_open
+                          else text)
+        self.stream.flush()
+        self._line_open = True
+
+    def on_chunk(self, rec):
+        b, h = rec["batch"], rec["lanes_halted"]
+        # h is None on non-addressable (multi-process) batches, where
+        # the runner can't fetch the per-lane halted vector
+        halted = (f"halted {h}/{b} ({100 * h / max(b, 1):.0f}%)"
+                  if h is not None else f"batch {b}")
+        stashed = (f"  +{rec['stashed_total']} stashed"
+                   if rec.get("stashed_total") else "")
+        self._show(
+            f"chunk {rec['chunk']:>4}  steps {rec['steps_done']:>8}  "
+            f"{halted}{stashed}  "
+            f"{_rate(rec['lane_steps_per_sec'])} lane-steps/s")
+
+    def on_compact(self, rec):
+        self._show(
+            f"compact @{rec['steps_done']}: {rec['from_batch']} -> "
+            f"{rec['to_batch']} lanes ({rec['stashed']} stashed)",
+            force=True)
+        self._line_open = False     # keep the repack visible
+        self.stream.write("\n")
+
+    def on_round(self, rec):
+        self._show(
+            f"round {rec['round']:>3}  +{rec['new_schedules']} new "
+            f"schedules ({rec['distinct_total']} distinct)  "
+            f"crashes {rec['crashes']}", force=True)
+
+    def on_done(self, rec):
+        parts = [f"done: {rec.get('steps_done', rec.get('seeds_run', 0))} "
+                 f"steps" if "steps_done" in rec
+                 else f"done: {rec.get('seeds_run', 0)} seeds"]
+        if rec.get("lanes_halted") is not None:
+            parts.append(f"halted {rec['lanes_halted']}/{rec['batch']}")
+        if "distinct_total" in rec:
+            parts.append(f"{rec['distinct_total']} distinct schedules")
+        if "wall_s" in rec:
+            parts.append(f"{rec['wall_s']:.2f}s")
+        self._show("  ".join(parts), force=True)
+        self.stream.write("\n")
+        self.stream.flush()
+        self._line_open = False

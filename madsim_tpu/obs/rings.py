@@ -1,0 +1,80 @@
+"""Read the on-device flight-recorder ring out of a final SimState.
+
+The ring is written inside the step (core/step.py, gated on
+cfg.trace_cap > 0 and the per-lane `trace_on` sampling mask set by
+`Runtime.init_batch(trace_lanes=...)`): the last trace_cap FIRED events
+per sampled lane, with `trace_pos` counting every event ever recorded, so
+`pos > cap` means the ring wrapped and the oldest `pos - cap` records were
+overwritten. Unlike the `collect_events` stream there are no frozen-lane
+`fired=False` rows to filter — the ring only ever holds real dispatches.
+
+Host-boundary cost: O(trace_cap) ints per sampled lane, transferred once,
+after the sweep — against O(steps x batch) for `collect_events`, which is
+why the ring is the path that works with `run_fused`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.state import TRACE_FIELDS
+
+# record columns = the tr_* schema fields, names sans prefix
+_COLS = tuple(f[3:] for f in TRACE_FIELDS if f.startswith("tr_"))
+
+
+def _require_addressable(state, what: str) -> None:
+    leaf = state.trace_on
+    if not getattr(leaf, "is_fully_addressable", True):
+        raise ValueError(
+            f"{what} needs an addressable state: this batch spans "
+            "non-addressable shards (multi-process sharding). Read rings "
+            "from the host that owns the lane — e.g. rebuild a local "
+            "state from `leaf.addressable_shards` / the per-host slice "
+            "that was assembled into the global batch — or gather the "
+            "tr_* columns explicitly before exporting")
+
+
+def sampled_lanes(state) -> np.ndarray:
+    """Indices of the lanes whose rings recorded (the `trace_lanes` the
+    batch was initialized with, as observed from the state itself)."""
+    _require_addressable(state, "sampled_lanes")
+    on = np.atleast_1d(np.asarray(state.trace_on))
+    return np.nonzero(on)[0]
+
+
+def ring_records(state, lane: int = 0) -> dict:
+    """One lane's ring, unwrapped into chronological order (host-side).
+
+    Returns {now, step, kind, node, src, tag: int32[n], total: int,
+    dropped: int} where n = min(total, trace_cap), `total` is every event
+    the lane ever recorded and `dropped` counts ring-wrap overwrites
+    (oldest-first). Raises if the runtime compiled the ring out or the
+    lane was not sampled — a silent empty trace would read as "nothing
+    happened". Under multi-process sharding, read on the host that owns
+    the lane (see the error message for the recipe) — the ring survives
+    the sharded `run_fused` fine; only the host-side read is local.
+    """
+    _require_addressable(state, "ring_records")
+    cols = {k: np.asarray(getattr(state, f"tr_{k}")) for k in _COLS}
+    pos = np.asarray(state.trace_pos)
+    on = np.asarray(state.trace_on)
+    if cols["now"].ndim == 2:          # batched state: select the lane
+        cols = {k: v[lane] for k, v in cols.items()}
+        pos, on = pos[lane], on[lane]
+    cap = cols["now"].shape[0]
+    if cap == 0:
+        raise ValueError("trace ring is compiled out (cfg.trace_cap == 0)")
+    if not bool(on):
+        raise ValueError(
+            f"lane {lane} was not sampled (init_batch trace_lanes mask); "
+            f"sampled lanes: {sampled_lanes(state).tolist()}")
+    total = int(pos)
+    n = min(total, cap)
+    # oldest surviving record sits at pos % cap once wrapped, at 0 before
+    start = total % cap if total > cap else 0
+    order = (start + np.arange(n)) % cap
+    out = {k: v[order] for k, v in cols.items()}
+    out["total"] = total
+    out["dropped"] = total - n
+    return out

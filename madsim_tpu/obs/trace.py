@@ -1,0 +1,94 @@
+"""Chrome-trace/Perfetto export: the visual timeline over virtual time.
+
+Converts either source of event records into one JSON document that
+chrome://tracing and ui.perfetto.dev open directly:
+
+  * a `collect_events` stream (Runtime.run(collect_events=True) /
+    run_single) — frozen-lane `fired=False` records are filtered out per
+    the overshoot contract (runtime/runtime.py run() docstring: consumers
+    filter on `fired`, never on step count);
+  * a flight-recorder ring (obs/rings.py) from any final state, including
+    one produced by `run_fused`.
+
+Track layout: one thread track per node (tid = node id, named via
+thread_name metadata), virtual-time microseconds on the time axis (the
+engine's tick IS a microsecond, so no scaling). Every dispatch renders as
+an instant event; supervisor ops (kill/restart/clog/...) land on the track
+of the node they act on, named "SUPER:<OP>", so a chaos script reads
+straight off the timeline.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core import types as T
+
+_KIND = {T.EV_MSG: "MSG", T.EV_TIMER: "TIMER", T.EV_SUPER: "SUPER"}
+_OP = {v: k[3:] for k, v in vars(T).items() if k.startswith("OP_")}
+
+
+def _event(now, kind, node, src, tag):
+    k = _KIND.get(kind, f"?{kind}")
+    if kind == T.EV_SUPER:
+        name = f"SUPER:{_OP.get(tag, tag)}"
+    else:
+        name = f"{k}:tag{tag}"
+    return dict(name=name, ph="i", s="t", ts=now, pid=0, tid=node,
+                args=dict(src=src, tag=tag))
+
+
+def _doc(events: list[dict], node_names=None) -> dict:
+    tids = sorted({e["tid"] for e in events})
+    meta = [dict(name="thread_name", ph="M", pid=0, tid=t,
+                 args=dict(name=(node_names[t] if node_names is not None
+                                 else f"node{t}")))
+            for t in tids]
+    return dict(traceEvents=meta + events, displayTimeUnit="ms")
+
+
+def to_chrome_events(source, b: int = 0) -> list[dict]:
+    """Normalize a record source into Chrome-trace instant events.
+
+    `source` is either the dict returned by `collect_events=True` (leaves
+    shaped [steps, batch, ...]; `b` selects the lane and `fired=False`
+    frozen-lane records are dropped) or a `ring_records()` dict (already
+    one lane, already only real dispatches).
+    """
+    if "fired" in source:                      # collect_events stream
+        cols = {k: np.asarray(source[k])[:, b]
+                for k in ("fired", "now", "kind", "node", "src", "tag")}
+        idx = np.nonzero(cols["fired"])[0]
+    else:                                      # ring_records dict
+        cols = source
+        idx = np.arange(len(np.asarray(cols["now"])))
+    return [_event(int(cols["now"][i]), int(cols["kind"][i]),
+                   int(cols["node"][i]), int(cols["src"][i]),
+                   int(cols["tag"][i]))
+            for i in idx]
+
+
+def export_chrome_trace(path: str, events=None, b: int = 0,
+                        state=None, lane: int = 0, node_names=None) -> int:
+    """Write one lane's trace as Chrome/Perfetto JSON; returns the number
+    of (non-metadata) trace events written — which equals the lane's
+    `fired=True` record count (collect_events source) or its surviving
+    ring length (state source).
+
+    Pass exactly one source: `events` (+ `b`) from a
+    `collect_events=True` run, or `state` (+ `lane`) to read the
+    flight-recorder ring of a final state — the only trace source a
+    `run_fused` sweep has.
+    """
+    if (events is None) == (state is None):
+        raise ValueError("pass exactly one of events= or state=")
+    if state is not None:
+        from .rings import ring_records
+        out = to_chrome_events(ring_records(state, lane))
+    else:
+        out = to_chrome_events(events, b)
+    with open(path, "w") as f:
+        json.dump(_doc(out, node_names), f)
+    return len(out)

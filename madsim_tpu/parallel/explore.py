@@ -25,6 +25,8 @@ reorders host work, never device math).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from . import stats
@@ -32,7 +34,7 @@ from . import stats
 
 def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             dry_rounds: int = 2, base_seed: int = 0, chunk: int = 512,
-            pipeline: bool = True, fused: bool = True):
+            pipeline: bool = True, fused: bool = True, observer=None):
     """Sweep seed batches until `dry_rounds` consecutive rounds add no
     new distinct schedule (or `max_rounds` is hit).
 
@@ -50,6 +52,13 @@ def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         The chunked runner syncs to the host every `chunk` steps, which
         serializes rounds regardless of `pipeline`; fused is what makes
         the pipeline actually overlap.
+      observer: optional obs.metrics.SweepObserver — an `on_round`
+        record per harvested round (coverage growth off the digest the
+        round already transfers: new_schedules, distinct_total, crashes)
+        and `on_done` with the final result. Hooks fire at the harvest
+        the loop already blocks on — no new host syncs, and observer
+        wall-time sits exactly where host dedup already overlaps device
+        compute in the pipelined path.
 
     Returns a dict:
       seeds_run            total seeds executed (harvested rounds only —
@@ -93,6 +102,7 @@ def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     # every chunk's host sync, so a "speculative" chunked round would run
     # to completion inline — all waste, no overlap
     speculate = pipeline and fused
+    t0 = time.perf_counter()
     pending = launch(0) if max_rounds > 0 else None
     for r in range(max_rounds):
         nxt = (launch(r + 1) if speculate and r + 1 < max_rounds else None)
@@ -106,11 +116,17 @@ def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         new_per_round.append(new)
         rounds += 1
         dry = dry + 1 if new == 0 else 0
+        if observer is not None:
+            observer.on_round(dict(
+                kind="round", round=rounds, batch=batch,
+                seeds_run=rounds * batch, new_schedules=new,
+                distinct_total=len(seen), crashes=n_crashed,
+                dry_rounds=dry, wall_s=time.perf_counter() - t0))
         if dry >= dry_rounds:
             break
         pending = nxt if nxt is not None else (
             launch(r + 1) if r + 1 < max_rounds else None)
-    return dict(
+    result = dict(
         seeds_run=rounds * batch,
         rounds=rounds,
         distinct_schedules=len(seen),
@@ -119,3 +135,8 @@ def explore(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         crash_first_seed_by_code=crashes,
         crashes=n_crashed,
     )
+    if observer is not None:
+        observer.on_done(dict(
+            kind="done", distinct_total=len(seen),
+            wall_s=time.perf_counter() - t0, **result))
+    return result

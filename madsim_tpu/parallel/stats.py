@@ -92,12 +92,21 @@ def schedule_representatives(state, seeds) -> dict:
 
 
 def summarize(rt, state, seeds=None) -> dict:
-    """One-call fleet report for a (finished or running) batched state."""
+    """One-call fleet report for a (finished or running) batched state.
+
+    `seeds` should be the exact seed array the batch was initialized
+    with; the `first_seed_by_code`/`first_crash_seed` fields are then
+    replayable handles. Without it the report falls back to LANE INDICES
+    — the exact trap `schedule_representatives` documents and refuses —
+    so the report says so explicitly: `seed_labels` is "seed" when real
+    seeds were given and "lane_index" otherwise (a lane index only
+    replays when the batch happened to be arange(B))."""
     halted = np.asarray(state.halted)
     crashed = np.asarray(state.crashed)
     codes = np.asarray(state.crash_code)
     now = np.asarray(state.now)
     B = halted.shape[0]
+    seed_labels = "seed" if seeds is not None else "lane_index"
     seeds = (np.asarray(seeds) if seeds is not None
              else np.arange(B))
 
@@ -111,6 +120,11 @@ def summarize(rt, state, seeds=None) -> dict:
     fps = rt.fingerprints(state)
     return dict(
         batch=B,
+        # what the *_seed fields actually label (see docstring): "seed"
+        # when the caller passed the batch's seed array, "lane_index"
+        # when it didn't — ambiguity is the footgun, so the report
+        # carries the distinction instead of implying seeds
+        seed_labels=seed_labels,
         halted=int(halted.sum()),
         crashed=int(crashed.sum()),
         crash_histogram=crash_hist,

@@ -12,6 +12,7 @@ table *inside* the trace; the Python loop only orchestrates jitted calls.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Sequence
 
 import jax
@@ -25,6 +26,18 @@ from ..core.state import SimState, init_state
 from ..core.step import make_step
 from ..utils.hashing import fingerprint
 from .scenario import Scenario
+
+
+def _halted_count(state) -> int | None:
+    """Halted-lane count for observer records; None when the batch spans
+    non-addressable shards (multi-process sharding), where fetching the
+    [B] lane would raise — the replicated-scalar `halted.all()` sync the
+    runners rely on still works there, so observers degrade gracefully
+    instead of killing the sweep."""
+    h = state.halted
+    if not getattr(h, "is_fully_addressable", True):
+        return None
+    return int(np.asarray(h).sum())
 
 
 class Runtime:
@@ -143,16 +156,42 @@ class Runtime:
             t_tag=jnp.asarray(tag), t_payload=jnp.asarray(payload))
 
     # ------------------------------------------------------------------
-    def init_batch(self, seeds) -> SimState:
+    def init_batch(self, seeds, trace_lanes=None) -> SimState:
         """Initial batched state for an array of seeds (replay-by-seed:
         the same seed always reproduces the same trajectory, the
-        MADSIM_TEST_SEED contract of macros lib.rs:141-145)."""
+        MADSIM_TEST_SEED contract of macros lib.rs:141-145).
+
+        trace_lanes: which LANES the flight-recorder ring records when
+        cfg.trace_cap > 0 (None = all; an int index array or a bool[B]
+        mask narrows it — the lane-sampling knob that lets a B=4096
+        sweep record 8 lanes instead of paying ring bandwidth on all of
+        them). Lanes, not seeds: sampling is a property of this batch's
+        layout, and obs/rings.py readers take lane indices too.
+        """
         seeds = jnp.atleast_1d(jnp.asarray(seeds, jnp.uint32))
         keys = jax.vmap(prng.seed_key)(seeds)
         batched = jax.tree.map(
             lambda a: jnp.broadcast_to(a, (seeds.shape[0],) + a.shape),
             self._template)
-        return batched.replace(key=keys)
+        batched = batched.replace(key=keys)
+        if trace_lanes is not None:
+            if self.cfg.trace_cap == 0:
+                raise ValueError(
+                    "trace_lanes given but cfg.trace_cap == 0 — the ring "
+                    "is compiled out; set SimConfig(trace_cap=...) > 0")
+            B = int(seeds.shape[0])
+            lanes = np.asarray(trace_lanes)
+            if lanes.dtype == bool:
+                if lanes.shape != (B,):
+                    raise ValueError(
+                        f"bool trace_lanes mask shape {lanes.shape} != "
+                        f"batch ({B},)")
+                mask = lanes
+            else:
+                mask = np.zeros(B, bool)
+                mask[lanes.astype(np.int64)] = True
+            batched = batched.replace(trace_on=jnp.asarray(mask))
+        return batched
 
     def init_single(self, seed: int) -> SimState:
         return self.init_batch(jnp.asarray([seed], jnp.uint32))
@@ -224,18 +263,23 @@ class Runtime:
         chunked runner exactly (tests/test_fused.py asserts this).
 
         Trade-offs vs `run()`: no `collect_events` (a while_loop cannot
-        stack per-step records; use `run()`/`run_single` for traces) and
-        no between-chunk host inspection (use `run()` for interactive
-        `inject`/`kill` supervision). Input buffers are DONATED — do not
-        reuse `state` after calling. Works on sharded, non-addressable
-        batches (it is pure SPMD), unlike `run_compacting`.
+        stack per-step records; use `run()`/`run_single` for the full
+        stream) and no between-chunk host inspection (use `run()` for
+        interactive `inject`/`kill` supervision). The fused path is NOT
+        blind, though: with `cfg.trace_cap > 0` the flight-recorder ring
+        rides in SimState through the while_loop, so the last trace_cap
+        events of every sampled lane come back with the final state
+        (obs/rings.py reads them; obs/trace.py exports Perfetto JSON).
+        Input buffers are DONATED — do not reuse `state` after calling.
+        Works on sharded, non-addressable batches (it is pure SPMD),
+        unlike `run_compacting`.
         """
         n_chunks = -(-max_steps // chunk)
         return self._fused_runner(state, jnp.asarray(n_chunks, jnp.int32),
                                   chunk)
 
     def run(self, state: SimState, max_steps: int, chunk: int = 512,
-            collect_events: bool = False):
+            collect_events: bool = False, observer=None):
         """Advance until every trajectory halts or ~max_steps events each
         (rounded up to a chunk multiple). Returns (state, events|None).
 
@@ -247,20 +291,49 @@ class Runtime:
         carry `fired=False` — trace consumers must filter on `fired`,
         never on step count (tests/test_fused.py asserts the frozen-lane
         tail is present and `fired=False`).
+
+        observer: optional obs.metrics.SweepObserver — gets an `on_chunk`
+        record at every chunk boundary (lanes halted, dispatched
+        lane-steps/s wall-clock) and an `on_done` at the end. The hooks
+        ride the host sync each chunk ALREADY pays for the
+        `halted.all()` test — no new sync points; the only extra cost is
+        transferring the [B] halted lane at a boundary the host was
+        blocked on anyway.
         """
         # always run full chunks: halted trajectories are frozen by the
         # live-mask gating inside the step, so overshooting max_steps is free
         # and avoids a second XLA compile for a partial tail chunk
         runner = self._run_chunk[collect_events]
         events = [] if collect_events else None
+        B = state.halted.shape[0]
         done = 0
+        k = 0
+        t0 = time.perf_counter()
+        t_prev = t0
         while done < max_steps:
             state, recs = runner(state, chunk)
             done += chunk
+            k += 1
             if collect_events:
                 events.append(jax.tree.map(np.asarray, recs))
-            if bool(state.halted.all()):
+            all_halted = bool(state.halted.all())
+            if observer is not None:
+                t_now = time.perf_counter()
+                observer.on_chunk(dict(
+                    kind="chunk", chunk=k, steps_done=done, batch=B,
+                    lanes_halted=_halted_count(state),
+                    wall_s=t_now - t0,
+                    lane_steps_per_sec=B * chunk / max(t_now - t_prev, 1e-9)))
+                t_prev = t_now
+            if all_halted:
                 break
+        if observer is not None:
+            wall = time.perf_counter() - t0
+            observer.on_done(dict(
+                kind="done", steps_done=done, batch=B, chunks=k,
+                lanes_halted=_halted_count(state),
+                wall_s=wall,
+                lane_steps_per_sec=B * done / max(wall, 1e-9)))
         if collect_events and events:
             events = jax.tree.map(
                 lambda *xs: np.concatenate(xs, axis=0), *events)
@@ -268,7 +341,7 @@ class Runtime:
 
     def run_compacting(self, state: SimState, max_steps: int,
                        chunk: int = 512, compact_when: float = 0.5,
-                       min_batch: int = 256):
+                       min_batch: int = 256, observer=None):
         """Like run(), but with divergent-trajectory early-exit compaction
         (BASELINE.md config 4): when more than `compact_when` of the lanes
         have halted, stash them host-side and re-pack the survivors into a
@@ -283,6 +356,11 @@ class Runtime:
         Under multi-process sharding (parallel/distributed.py) run() works
         unchanged — frozen lanes are already ~free there — or compact each
         host's local slice before assembling the global batch.
+
+        observer: optional obs.metrics.SweepObserver — `on_chunk` per
+        chunk, `on_compact` at every re-pack (from/to batch widths), and
+        `on_done` at the end; hooks ride the per-chunk host sync this
+        runner already pays (it transfers the full halted lane anyway).
         """
         leaf = jax.tree.leaves(state)[0]
         if (hasattr(leaf, "is_fully_addressable")
@@ -297,11 +375,33 @@ class Runtime:
         orig_idx = np.arange(B)
         stash: list[tuple[np.ndarray, Any]] = []  # (orig indices, host copy)
         done = 0
+        k = 0
+        repacks = 0
+        stashed_total = 0
+        t0 = time.perf_counter()
+        t_prev = t0
         while done < max_steps:
             state, _ = runner(state, chunk)
             done += chunk
+            k += 1
             halted = np.asarray(state.halted)
             n = halted.shape[0]
+            if observer is not None:
+                t_now = time.perf_counter()
+                # same convention as run(): lanes_halted is a fraction
+                # OF `batch` (the current, post-compaction width);
+                # stashed lanes are reported separately so global
+                # progress is lanes_halted + stashed_total of the
+                # original batch, and a h/batch progress bar never
+                # exceeds 100%
+                observer.on_chunk(dict(
+                    kind="chunk", chunk=k, steps_done=done, batch=n,
+                    lanes_halted=int(halted.sum()),
+                    stashed_total=stashed_total,
+                    wall_s=t_now - t0,
+                    lane_steps_per_sec=n * chunk / max(t_now - t_prev,
+                                                       1e-9)))
+                t_prev = t_now
             if halted.all():
                 break
             live = int((~halted).sum())
@@ -314,13 +414,42 @@ class Runtime:
                     pad_idx = np.nonzero(halted)[0][:target - live]
                     keep = np.concatenate([live_idx, pad_idx])
                     drop = np.setdiff1d(np.arange(n), keep)
-                    host = jax.tree.map(np.asarray, state)
+                    # OWNED copies, not np.asarray views: on the CPU
+                    # backend np.asarray of a device array can be
+                    # zero-copy, and the next runner() call DONATES the
+                    # state buffers — a stashed view would then read
+                    # recycled memory (observed as 0x01010101 garbage
+                    # when the chunk executable came from the persistent
+                    # compile cache, whose buffer lifetimes differ from
+                    # the fresh-compile path)
+                    host = jax.tree.map(
+                        lambda a: np.array(a, copy=True), state)
                     stash.append((orig_idx[drop],
                                   jax.tree.map(lambda a: a[drop], host)))
                     state = jax.tree.map(lambda a: jnp.asarray(a[keep]), host)
                     orig_idx = orig_idx[keep]
+                    repacks += 1
+                    stashed_total += len(drop)
+                    if observer is not None:
+                        observer.on_compact(dict(
+                            kind="compact", steps_done=done,
+                            from_batch=n, to_batch=target,
+                            stashed=len(drop), stashed_total=stashed_total,
+                            wall_s=time.perf_counter() - t0))
+        if observer is not None:
+            wall = time.perf_counter() - t0
+            # done is batch-global: every stashed lane is halted by
+            # construction, so halted-in-final + stashed is of B
+            observer.on_done(dict(
+                kind="done", steps_done=done, batch=B, chunks=k,
+                repacks=repacks,
+                lanes_halted=int(np.asarray(state.halted).sum())
+                + stashed_total,
+                stashed_total=stashed_total,
+                wall_s=wall))
         # merge: stashed lanes + final state, back in original order
-        final_host = jax.tree.map(np.asarray, state)
+        # (owned copies for the same donation-aliasing reason as above)
+        final_host = jax.tree.map(lambda a: np.array(a, copy=True), state)
         parts = stash + [(orig_idx, final_host)]
         order = np.concatenate([p[0] for p in parts])
         inv = np.argsort(order)

@@ -13,9 +13,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core import types as T
-
-_KIND = {T.EV_MSG: "MSG", T.EV_TIMER: "TIMER", T.EV_SUPER: "SUPER"}
-_OP = {v: k[3:] for k, v in vars(T).items() if k.startswith("OP_")}
+from ..obs.trace import _KIND, _OP  # one source for event-name rendering
 
 
 def _columns(events: dict, b: int):
@@ -68,32 +66,8 @@ def print_trace(events: dict, b: int = 0, **kw) -> None:
 
 def export_chrome_trace(events: dict, path: str, b: int = 0,
                         node_names=None) -> int:
-    """Write one seed's event stream as a Chrome/Perfetto trace JSON
-    (open in chrome://tracing or ui.perfetto.dev): one row per node,
-    instant events at virtual-time microseconds. Returns event count.
-
-    The visual-timeline upgrade over the reference's text logger — the
-    virtual clock maps directly onto the trace's microsecond axis.
-    """
-    import json
-
-    cols, idx = _columns(events, b)
-    now, kind = cols["now"], cols["kind"]
-    node, src, tag = cols["node"], cols["src"], cols["tag"]
-    out = []
-    for i in idx:
-        k = _KIND.get(int(kind[i]), "?")
-        name = (f"{k}:{_OP.get(int(tag[i]), tag[i])}" if kind[i] == T.EV_SUPER
-                else f"{k}:tag{tag[i]}")
-        out.append(dict(
-            name=name, ph="i", s="t",
-            ts=int(now[i]), pid=0, tid=int(node[i]),
-            args=dict(src=int(src[i]), tag=int(tag[i])),
-        ))
-    meta = [dict(name="thread_name", ph="M", pid=0, tid=t,
-                 args=dict(name=(node_names[t] if node_names is not None
-                                 else f"node{t}")))
-            for t in sorted(set(node[idx].tolist()))]
-    with open(path, "w") as f:
-        json.dump(dict(traceEvents=meta + out, displayTimeUnit="ms"), f)
-    return len(out)
+    """Back-compat shim for the original exporter signature; the
+    implementation (and the ring-source variant `run_fused` sweeps need)
+    lives in obs/trace.py."""
+    from ..obs.trace import export_chrome_trace as _export
+    return _export(path, events=events, b=b, node_names=node_names)

@@ -29,12 +29,28 @@ def _leaf_words(a: jax.Array) -> jax.Array:
     return w.reshape(-1)
 
 
+from ..core.state import TRACE_FIELDS
+
+# The recorder is an observation lever, not a replay domain: two lanes
+# running identical trajectories must fingerprint equal whether or not
+# one of them was sampled into the ring — otherwise partial
+# `trace_lanes` sampling would split every trajectory class in
+# `summarize()['distinct_outcomes']` and a sampled sweep's fingerprints
+# would never match a replay's.
+_OBSERVATION_FIELDS = frozenset(TRACE_FIELDS)
+
+
 def fingerprint(state) -> jax.Array:
-    """uint32 fingerprint of one trajectory's full state pytree.
+    """uint32 fingerprint of one trajectory's full state pytree —
+    excluding the flight-recorder (observation-only) fields.
 
     vmap this for a batched state. Deterministic given identical values and
     identical pytree structure/shapes.
     """
+    if hasattr(state, "trace_pos"):     # SimState: drop the recorder
+        state = {k: getattr(state, k)
+                 for k in type(state).__dataclass_fields__
+                 if k not in _OBSERVATION_FIELDS}
     leaves = jax.tree.leaves(state)
     h = FNV_OFFSET
     for i, leaf in enumerate(leaves):

@@ -24,6 +24,10 @@ case "$tier" in
     # seconds-scale fused-runner smoke: run_fused must stay bitwise-equal
     # to the chunked runner and the pipelined explore() must round-trip
     python bench.py --fused-smoke
+    # observability smoke: a tiny traced fused sweep must yield a readable
+    # ring that exports as valid Chrome-trace JSON, and the exporter's
+    # event counts must agree with the engine's own fired counts
+    python bench.py --obs-smoke
     ;;
   full)
     python -m pytest tests/ -q

@@ -1,7 +1,7 @@
 """Profile the flagship bench step on the live device and print the top
 HLO ops by self-time.
 
-Usage: python scripts/profile_step.py [steps] [--fused]
+Usage: python scripts/profile_step.py [steps] [--fused] [--trace-out DIR]
 Captures a jax.profiler device trace of one timed chunk (default 64
 steps, B=4096 — the bench configuration) and aggregates the device
 plane's XLA-op events by name. This is the method that produced the
@@ -13,6 +13,14 @@ over the same step budget instead of one chunked dispatch — the trace
 then shows the whole sweep as ONE device program, with no host gap
 between chunks; compare against the default mode to see what the
 per-chunk sync actually costs on the live chip.
+
+--trace-out DIR keeps the raw profiler trace under DIR instead of a
+throwaway tempdir: load DIR in ui.perfetto.dev (or tensorboard --logdir)
+to see the dispatch timeline visually. This is the WALL-CLOCK half of the
+observability story — obs/trace.py exports the VIRTUAL-time timeline of
+what the simulated cluster did; this shows what the hardware did running
+it. The op-level text summary prints either way (when the xplane protos
+are importable).
 """
 import collections
 import glob
@@ -24,8 +32,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    fused = "--fused" in sys.argv
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    argv = list(sys.argv[1:])
+    trace_out = None
+    if "--trace-out" in argv:
+        i = argv.index("--trace-out")
+        if i + 1 >= len(argv):
+            sys.exit("usage: profile_step.py [steps] [--fused] "
+                     "[--trace-out DIR]")
+        trace_out = argv[i + 1]
+        del argv[i:i + 2]
+    fused = "--fused" in argv
+    args = [a for a in argv if not a.startswith("--")]
     steps = int(args[0]) if args else 64
     import numpy as np
     import jax
@@ -43,18 +60,30 @@ def main():
     state, _ = runner(state, steps)          # compile + warm
     jax.block_until_ready(state.now)
 
-    tmp = tempfile.mkdtemp(prefix="madsim_prof_")
-    with jax.profiler.trace(tmp):
+    if trace_out:
+        out_dir = trace_out
+        os.makedirs(out_dir, exist_ok=True)
+    else:
+        out_dir = tempfile.mkdtemp(prefix="madsim_prof_")
+    with jax.profiler.trace(out_dir):
         state, _ = runner(state, steps)
         jax.block_until_ready(state.now)
+    if trace_out:
+        print(f"profiler trace kept under {out_dir} — load it in "
+              f"ui.perfetto.dev or `tensorboard --logdir {out_dir}`")
 
-    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
-    assert paths, f"no xplane under {tmp}"
+    paths = glob.glob(os.path.join(out_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert paths, f"no xplane under {out_dir}"
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
     except ImportError as e:
-        sys.exit(f"trace written to {tmp} but the op-level summary needs "
-                 f"TensorFlow's xplane protos (optional dep): {e}")
+        msg = (f"trace written to {out_dir} but the op-level summary needs "
+               f"TensorFlow's xplane protos (optional dep): {e}")
+        if trace_out:
+            print(msg, file=sys.stderr)     # the kept trace IS the output
+            return
+        sys.exit(msg)
     xspace = xplane_pb2.XSpace()
     with open(paths[0], "rb") as f:
         xspace.ParseFromString(f.read())

@@ -6,6 +6,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy (r7 durations triage:
+# many distinct step programs per run); tier-1/ci.sh fast skip it so the
+# fast lane fits its 870s budget cold
+
 from madsim_tpu import Program, Runtime, SimConfig, ms, sec
 from madsim_tpu.harness.simtest import run_seeds
 from madsim_tpu.net import codegen, rpc, stream, streaming

@@ -13,6 +13,9 @@ which DESIGN §4 forbids.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # chaos-sweep-heavy (r7 durations triage);
+# tier-1/ci.sh fast skip it so the fast lane fits its 870s budget cold
+
 from madsim_tpu import Runtime, Scenario, SimConfig, NetConfig, ms, sec
 from madsim_tpu.models.pingpong import PingPong, state_spec
 from madsim_tpu.parallel import stats

@@ -1,6 +1,10 @@
 """Gossip dissemination under faults + propagation-time statistics."""
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # chaos-sweep-heavy (r7 durations triage);
+# tier-1/ci.sh fast skip it so the fast lane fits its 870s budget cold
 
 from madsim_tpu import Scenario, SimConfig, NetConfig, ms, sec
 from madsim_tpu.harness.simtest import run_seeds

@@ -7,6 +7,11 @@ in-process complement of the driver's dryrun_multichip and the
 
 import jax
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy (r7 durations triage:
+# many distinct step programs per run); tier-1/ci.sh fast skip it so the
+# fast lane fits its 870s budget cold
 
 from madsim_tpu import Runtime, Scenario, SimConfig, NetConfig, ms
 from madsim_tpu.core.types import sec

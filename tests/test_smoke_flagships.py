@@ -14,6 +14,10 @@ tier.
 """
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # chaos-sweep-heavy (r7 durations triage);
+# tier-1/ci.sh fast skip it so the fast lane fits its 870s budget cold
 
 from madsim_tpu import NetConfig, SimConfig, ms, sec
 from madsim_tpu.harness.simtest import run_seeds
