@@ -629,6 +629,158 @@ def _obs_ab_mode():
     print(json.dumps(out))
 
 
+def _make_raft_compile_matrix_runtime(time_limit, loss, lat_hi,
+                                      share: bool):
+    """One cell of the compile_ab matrix: the flagship Raft step program
+    with a small log, varying ONLY dynamic knobs (time limit, loss,
+    latency) so every cell shares one structural signature."""
+    from madsim_tpu import Runtime, Scenario, SimConfig, NetConfig, ms, sec
+    from madsim_tpu.models.raft import (Raft, persist_spec, raft_invariant,
+                                        state_spec)
+    cfg = SimConfig(n_nodes=5, event_capacity=128, time_limit=time_limit,
+                    net=NetConfig(packet_loss_rate=loss,
+                                  send_latency_min=ms(1),
+                                  send_latency_max=lat_hi))
+    sc = Scenario()
+    sc.at(sec(1)).kill_random()
+    sc.at(sec(1) + ms(400)).restart_random()
+    return Runtime(cfg, [Raft(5, 8, 4, 0)], state_spec(5, 8), scenario=sc,
+                   invariant=raft_invariant(5, 8), persist=persist_spec(),
+                   share_programs=share)
+
+
+def _compile_ab_mode():
+    """--mode compile_ab: cold-vs-shared compile A/B (CPU; the win is
+    fully measurable with the TPU tunnel dead). A 6-config matrix of the
+    flagship Raft step program sharing ONE structural signature (cells
+    differ only in dynamic knobs: time limit, loss, latency) is driven
+    two ways:
+
+      per_runtime  share_programs=False — every Runtime owns private jits
+                   (the pre-cache world): 6 traces, 6 XLA compiles
+      shared       share_programs=True through a cleared PROGRAM_CACHE:
+                   cell 1 compiles, cells 2-6 reuse the executable
+
+    Each cell's trace+compile cost is measured as (first call) - (warm
+    call) on the chunked runner at B=512; the JAX persistent compile
+    cache is disabled so the control is genuinely cold. Also records the
+    AOT trace/lower/compile stage split for one cell (compile/timing.py)
+    and the COMPILE_LOG/PROGRAM_CACHE counters. Writes
+    BENCH_compile_ab_<platform>.json next to this file."""
+    _force_cpu_inprocess()
+    import jax
+    from madsim_tpu import sec as _sec, ms as _ms
+    from madsim_tpu.compile.cache import COMPILE_LOG, PROGRAM_CACHE
+    from madsim_tpu.compile.timing import timed_stages
+    # honest cold control: no on-disk reuse
+    jax.config.update("jax_compilation_cache_dir", None)
+    platform = jax.devices()[0].platform
+    B, chunk = 512, 256
+    matrix = [(_sec(2 + i % 3), 0.01 * i, _ms(4 + i)) for i in range(6)]
+    seeds = np.arange(B)
+
+    def cell_cost(rt):
+        runner = rt._run_chunk[False]
+        state = rt.init_batch(seeds)
+        t0 = time.perf_counter()
+        state, _ = runner(state, chunk)
+        jax.block_until_ready(state.now)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        state, _ = runner(state, chunk)
+        jax.block_until_ready(state.now)
+        warm = time.perf_counter() - t0
+        return max(first - warm, 0.0), warm
+
+    out = {"metric": "compile_ab", "platform": platform, "batch": B,
+           "chunk": chunk, "configs": len(matrix),
+           "note": ("6-config flagship-Raft matrix, one structural "
+                    "signature, dynamic knobs only; trace+compile per "
+                    "cell = first-call minus warm-call wall on the "
+                    "chunked runner; persistent compile cache disabled "
+                    "for the control")}
+    results = {}
+    for name, share in (("per_runtime", False), ("shared", True)):
+        PROGRAM_CACHE.clear()
+        t_trace0 = COMPILE_LOG.snapshot()["traces_total"]
+        per = []
+        for (tl, loss, lat) in matrix:
+            rt = _make_raft_compile_matrix_runtime(tl, loss, lat, share)
+            tc, warm = cell_cost(rt)
+            per.append(round(tc, 3))
+        results[name] = {
+            "per_config_trace_compile_s": per,
+            "total_trace_compile_s": round(sum(per), 3),
+            "traces": COMPILE_LOG.snapshot()["traces_total"] - t_trace0,
+        }
+        print(f"--compile-ab: {name} total trace+compile "
+              f"{sum(per):.1f}s over {len(per)} configs "
+              f"({results[name]['traces']} traces)", file=sys.stderr)
+    out.update(results)
+    out["reduction_x"] = round(
+        results["per_runtime"]["total_trace_compile_s"]
+        / max(results["shared"]["total_trace_compile_s"], 1e-9), 2)
+    # AOT stage split for one cell (fresh private jit, so nothing cached)
+    rt = _make_raft_compile_matrix_runtime(*matrix[0], share=False)
+    stages = timed_stages(rt._compile_chunk(False), rt.init_batch(seeds),
+                          chunk)
+    out["stages_one_config"] = {
+        k: round(v, 3) for k, v in stages.items()
+        if k != "compiled" and v is not None}
+    out["compile_log"] = COMPILE_LOG.snapshot()
+    out["compile_events"] = COMPILE_LOG.recent(16)
+    out["program_cache"] = PROGRAM_CACHE.stats()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_compile_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _compile_smoke_mode():
+    """--compile-smoke: seconds-scale compile-cache self-test for CI
+    (scripts/ci.sh fast --compile-smoke): two structurally-equal configs
+    (dynamic knobs differ) must resolve to the SAME chunk-runner object,
+    cost exactly ONE retrace between them, and produce results bitwise
+    equal to a fresh-compile (share_programs=False) control. Forced to
+    CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    from madsim_tpu import Runtime
+    from madsim_tpu.compile.cache import COMPILE_LOG, PROGRAM_CACHE
+    from madsim_tpu.models.pingpong import PingPong, state_spec
+    t0 = time.perf_counter()
+    seeds = np.arange(64)
+    before = COMPILE_LOG.snapshot()["traces"].get("chunk_runner", 0)
+    rt1 = _make_light_runtime()
+    from madsim_tpu import SimConfig, NetConfig, ms, sec
+    cfg2 = SimConfig(n_nodes=2, event_capacity=16, payload_words=2,
+                     time_limit=sec(123), collect_stats=False,
+                     net=NetConfig(packet_loss_rate=0.02,
+                                   send_latency_min=ms(1),
+                                   send_latency_max=ms(4)))
+    rt2 = Runtime(cfg2, [PingPong(2, target=1 << 30)], state_spec())
+    assert rt1._sig == rt2._sig, "structural signatures must match"
+    assert rt1._run_chunk[False] is rt2._run_chunk[False], \
+        "structurally-equal configs must share one chunk runner"
+    s1, _ = rt1.run(rt1.init_batch(seeds), 192, 64)
+    s2, _ = rt2.run(rt2.init_batch(seeds), 192, 64)
+    traces = COMPILE_LOG.snapshot()["traces"].get("chunk_runner",
+                                                  0) - before
+    assert traces == 1, f"expected exactly 1 retrace for the pair, got " \
+        f"{traces}"
+    ctrl = Runtime(cfg2, [PingPong(2, target=1 << 30)], state_spec(),
+                   share_programs=False)
+    sc, _ = ctrl.run(ctrl.init_batch(seeds), 192, 64)
+    assert (ctrl.fingerprints(sc) == rt2.fingerprints(s2)).all(), \
+        "shared-executable run diverged from fresh compile"
+    print(json.dumps({
+        "metric": "compile_smoke", "platform": "cpu", "ok": True,
+        "traces_for_pair": traces,
+        "cache": PROGRAM_CACHE.describe(),
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
 def _obs_smoke_mode():
     """--obs-smoke: seconds-scale observability self-test for CI (wired
     into scripts/ci.sh fast): a tiny traced sweep through the FUSED
@@ -928,11 +1080,18 @@ def main():
                  "--shape-sweep", "--sweep", "--shardkv", "--minipg",
                  "--ministream", "--all", "--sched-ab", "--realworld",
                  "--scaling", "--cpu-baseline", "--native-baseline",
-                 "--obs-ab", "--obs-smoke"}
+                 "--obs-ab", "--obs-smoke", "--compile-ab",
+                 "--compile-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
         sys.argv.append(flag)
+    if "--compile-ab" in sys.argv:
+        _compile_ab_mode()
+        return
+    if "--compile-smoke" in sys.argv:
+        _compile_smoke_mode()
+        return
     if "--obs-ab" in sys.argv:
         _obs_ab_mode()
         return

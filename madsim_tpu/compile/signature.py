@@ -1,0 +1,219 @@
+"""Structural signatures: the cache key for "compiles to the same program".
+
+Two Runtime constructions may share a compiled step program iff everything
+that is BAKED INTO THE TRACE is equal: the structural slice of SimConfig
+(`SimConfig.structural_signature()`), the program handlers' code and
+captured parameters, the state-spec defaults (they become boot-reset
+constants in `_apply_super`), the node->program map, the persist mask, and
+the invariant/halt_when checks. Everything else — scenario rows, seeds,
+time limit, loss/latency/jitter values — is initial-state DATA and must
+NOT appear here, or it would key spurious recompiles.
+
+`freeze()` turns those ingredients into a hashable value. It is
+deliberately conservative: anything it cannot prove stable (an object of
+unknown type, a recursive structure) freezes to a per-object identity
+token, which silently disables CROSS-Runtime sharing for that runtime but
+never produces a false cache hit. Functions freeze to (code object,
+frozen defaults, frozen closure cells), so factory-built closures like
+`raft_invariant(5, 32)` compare equal across calls — the flagship models
+all build their invariants that way.
+"""
+
+from __future__ import annotations
+
+import itertools
+import types
+import weakref
+from typing import Any
+
+import numpy as np
+
+# leaves bigger than this hash to a digest instead of carrying raw bytes
+# in the key (keys live for the cache entry's lifetime)
+_INLINE_BYTES = 1 << 12
+
+_TOKENS = itertools.count()
+_TOKEN_ATTR = "_madsim_tpu_sig_token"
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (0 -> 0): the bucketing rule for
+    capacity-like knobs whose exact value rides as a dynamic operand."""
+    n = int(n)
+    return 0 if n <= 0 else 1 << (n - 1).bit_length()
+
+
+class _Unique:
+    """Identity token: hashable, equal only to itself. Freezing a value to
+    one of these keeps the cache sound (the same OBJECT still reuses its
+    entry) while opting that runtime out of cross-instance sharing."""
+
+    __slots__ = ("_n",)
+
+    def __init__(self):
+        self._n = next(_TOKENS)
+
+    def __hash__(self):
+        return hash(("_madsim_unique", self._n))
+
+    def __eq__(self, other):
+        return self is other
+
+    def __repr__(self):
+        return f"<unique #{self._n}>"
+
+
+_WEAK_TOKENS: "weakref.WeakKeyDictionary[Any, _Unique]" = \
+    weakref.WeakKeyDictionary()
+
+
+def _unique_for(obj: Any):
+    """A token stable for the lifetime of `obj`: stashed on the object
+    when it allows attributes, else weak-keyed by it. Never keyed by
+    bare id() — id reuse after GC could alias a live cache entry; here
+    the token (or the weak entry) dies with the object."""
+    try:
+        tok = getattr(obj, _TOKEN_ATTR, None)
+        if tok is None:
+            tok = _Unique()
+            setattr(obj, _TOKEN_ATTR, tok)
+        return tok
+    except (AttributeError, TypeError):
+        pass
+    try:
+        tok = _WEAK_TOKENS.get(obj)
+        if tok is None:
+            tok = _Unique()
+            _WEAK_TOKENS[obj] = tok
+        return tok
+    except TypeError:   # neither attributable nor weakref-able
+        return _Unique()
+
+
+def _global_names(code, _depth: int = 0) -> set:
+    """Names a code object (and its nested lambdas/comprehensions) may
+    resolve from module globals — co_names, walked through co_consts."""
+    names = set(code.co_names)
+    if _depth < 4:
+        for c in code.co_consts:
+            if isinstance(c, types.CodeType):
+                names |= _global_names(c, _depth + 1)
+    return names
+
+
+def _freeze_array(a) -> tuple:
+    arr = np.asarray(a)
+    blob = arr.tobytes()
+    if len(blob) > _INLINE_BYTES:
+        import hashlib
+        blob = hashlib.sha256(blob).digest()
+    return ("arr", str(arr.dtype), arr.shape, blob)
+
+
+def freeze(v: Any, _depth: int = 0, _seen: frozenset = frozenset()) -> Any:
+    """Hashable, value-based encoding of `v` — see module docstring for
+    the soundness contract (unknown -> identity token, never a false
+    equality). `_seen` carries the ids on the CURRENT walk path so
+    cyclic references (a recursive function's own global binding,
+    mutually-referencing module helpers) encode as a stable structural
+    marker instead of an identity token — the cycle's shape is already
+    captured by the enclosing tuples."""
+    if _depth > 24:                      # pathological nesting
+        return _unique_for(v)
+    if id(v) in _seen:
+        return ("cycle", type(v).__name__)
+    d = _depth + 1
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        # tag with the type name: 1 == True == 1.0 under Python hashing,
+        # but they trace differently
+        return (type(v).__name__, v)
+    s = _seen | {id(v)}
+    if isinstance(v, (tuple, list)):
+        return ("seq", type(v).__name__,
+                tuple(freeze(x, d, s) for x in v))
+    if isinstance(v, dict):
+        items = sorted(v.items(), key=lambda kv: repr(kv[0]))
+        return ("dict", tuple((freeze(k, d, s), freeze(x, d, s))
+                              for k, x in items
+                              if not (isinstance(k, str)
+                                      and k.startswith("_madsim"))))
+    if isinstance(v, (frozenset, set)):
+        # frozenset of the frozen elements: order-independent equality
+        # without repr() (code-object reprs embed memory addresses)
+        return ("set", frozenset(freeze(x, d, s) for x in v))
+    if isinstance(v, np.ndarray) or type(v).__module__.startswith("jax"):
+        try:
+            return _freeze_array(v)
+        except Exception:  # noqa: BLE001 - tracer/abstract value etc.
+            return _unique_for(v)
+    if isinstance(v, np.generic):
+        return _freeze_array(v)
+    if isinstance(v, types.ModuleType):
+        # by-name ONLY for the module actually registered under that
+        # name — two distinct module objects sharing a __name__ (exec'd
+        # namespaces, test doubles) must not alias. NOTE the contract
+        # limit this implies: mutating a REGISTERED module's attributes
+        # between Runtime constructions is invisible to the signature,
+        # exactly like mutating a Program after construction (DESIGN
+        # §10 freezes both at construction time).
+        import sys
+        if sys.modules.get(v.__name__) is v:
+            return ("mod", v.__name__)
+        return _unique_for(v)
+    if isinstance(v, types.MethodType):
+        return ("method", freeze(v.__func__, d, s), freeze(v.__self__, d, s))
+    if isinstance(v, types.FunctionType):
+        cells = tuple(freeze(c.cell_contents, d, s)
+                      for c in (v.__closure__ or ()))
+        # referenced module globals are part of the function's behavior:
+        # CPython compares code objects by VALUE, so byte-identical
+        # source in two modules yields equal code objects even when the
+        # globals they read differ — fold those bindings in like cells
+        gnames = sorted(_global_names(v.__code__)
+                        & v.__globals__.keys())
+        gvals = tuple((n, freeze(v.__globals__[n], d, s)) for n in gnames)
+        return ("fn", v.__code__,
+                freeze(v.__defaults__, d, s),
+                freeze(v.__kwdefaults__, d, s),  # kw-only defaults bake
+                cells, gvals)                    # into the trace too
+    if isinstance(v, type):
+        return ("cls", v)                  # class object itself (hashable)
+    import functools
+    if isinstance(v, functools.partial):
+        return ("partial", freeze(v.func, d, s), freeze(v.args, d, s),
+                freeze(v.keywords, d, s))
+    # objects with a plain attribute dict (Programs, Extensions, config
+    # dataclasses): type + frozen attributes. This is what makes two
+    # `Raft(5, 32, ...)` instances from different factory calls equal.
+    dct = getattr(v, "__dict__", None)
+    if isinstance(dct, dict):
+        return ("obj", type(v), freeze(dct, d, s))
+    return _unique_for(v)
+
+
+def program_signature(prog) -> Any:
+    """Value signature of one Program (type + captured parameters)."""
+    return freeze(prog)
+
+
+def runtime_signature(cfg, programs, node_prog, state_spec, invariant,
+                      persist, halt_when, extensions) -> Any:
+    """The full step-program cache key for one Runtime construction —
+    every ingredient `core.step.make_step` bakes into the trace.
+
+    The batch shape is deliberately absent: `jax.jit` re-specializes per
+    input aval under one cached callable, so distinct batch widths share
+    the Python-level entry and split only at XLA level (which is exactly
+    the granularity executables differ at)."""
+    node_prog = np.asarray(node_prog, np.int32)
+    return (
+        "rt-sig-v1",
+        cfg.structural_signature(),
+        tuple(program_signature(p) for p in programs),
+        ("node_prog", node_prog.shape, node_prog.tobytes()),
+        freeze(state_spec),
+        freeze(invariant),
+        freeze(persist),
+        freeze(halt_when),
+        tuple(freeze(e) for e in extensions),
+    )

@@ -31,8 +31,10 @@ from . import types as T
 # from fingerprints (utils/hashing — observation only, never a replay
 # domain), read by obs/rings.py, compared explicitly in the
 # fused-vs-chunked equivalence tests and bench.py --obs-smoke.
-TRACE_FIELDS = ("trace_on", "trace_pos", "tr_now", "tr_step", "tr_kind",
-                "tr_node", "tr_src", "tr_tag")
+# trace_cap is the DYNAMIC capacity operand (columns are sized to the
+# power-of-two bucket, cfg.trace_cap_bucket — DESIGN §10).
+TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
+                "tr_kind", "tr_node", "tr_src", "tr_tag")
 
 
 @struct.dataclass
@@ -107,13 +109,18 @@ class SimState:
     trace_pos: jax.Array    # int32 — events recorded so far (monotonic;
                             # the write slot is trace_pos % trace_cap, so
                             # pos > cap means the ring wrapped)
-    tr_now: jax.Array       # int32[trace_cap] — virtual time of the event
-    tr_step: jax.Array      # int32[trace_cap] — step index (cross-ref with
+    trace_cap: jax.Array    # int32 — LOGICAL ring capacity (dynamic:
+                            # cfg.trace_cap; the columns below are sized
+                            # to its power-of-two bucket so sweeping
+                            # trace_cap never recompiles — rows past
+                            # trace_cap are simply never written)
+    tr_now: jax.Array       # int32[bucket] — virtual time of the event
+    tr_step: jax.Array      # int32[bucket] — step index (cross-ref with
                             # collect_events row order / state_at)
-    tr_kind: jax.Array      # int32[trace_cap]
-    tr_node: jax.Array      # int32[trace_cap]
-    tr_src: jax.Array       # int32[trace_cap]
-    tr_tag: jax.Array       # int32[trace_cap]
+    tr_kind: jax.Array      # int32[bucket]
+    tr_node: jax.Array      # int32[bucket]
+    tr_src: jax.Array       # int32[bucket]
+    tr_tag: jax.Array       # int32[bucket]
 
     # --- extension state (plugin framework analog, plugin.rs) -------------
     ext: Any                # dict: extension name -> its state subtree
@@ -162,15 +169,17 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         msg_dropped=jnp.asarray(0, i32),
         ev_peak=jnp.asarray(0, i32),
         # recorder default: every lane samples (when the ring is compiled
-        # in at all); init_batch(trace_lanes=...) narrows the mask
+        # in at all); init_batch(trace_lanes=...) narrows the mask.
+        # Columns are bucket-sized; trace_cap is the dynamic capacity.
         trace_on=jnp.asarray(cfg.trace_cap > 0),
         trace_pos=jnp.asarray(0, i32),
-        tr_now=jnp.zeros((cfg.trace_cap,), i32),
-        tr_step=jnp.zeros((cfg.trace_cap,), i32),
-        tr_kind=jnp.zeros((cfg.trace_cap,), i32),
-        tr_node=jnp.zeros((cfg.trace_cap,), i32),
-        tr_src=jnp.zeros((cfg.trace_cap,), i32),
-        tr_tag=jnp.zeros((cfg.trace_cap,), i32),
+        trace_cap=jnp.asarray(cfg.trace_cap, i32),
+        tr_now=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        tr_step=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        tr_kind=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        tr_node=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        tr_src=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        tr_tag=jnp.zeros((cfg.trace_cap_bucket,), i32),
         ext=ext_state if ext_state is not None else {},
     )
 

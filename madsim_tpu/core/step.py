@@ -447,12 +447,17 @@ def make_step(
         # non-trace state stays bit-identical across trace_cap settings.
         if cfg.trace_cap > 0:
             rec_w = record["fired"] & s.trace_on
-            slot = jnp.mod(s.trace_pos, cfg.trace_cap)
+            # DYNAMIC capacity (s.trace_cap), bucket-sized columns: the
+            # compiled program depends only on cfg.trace_cap_bucket, so
+            # sweeping trace_cap within a bucket shares one executable;
+            # slots stay < trace_cap, so rows past it are never written
+            # and ring contents are bit-identical to an unbucketed build
+            slot = jnp.mod(s.trace_pos, s.trace_cap)
             # one shared one-hot row mask for all six columns (the
-            # columns are [cap] vectors, so put_row's per-call reshape
+            # columns are [bucket] vectors, so put_row's per-call reshape
             # is unnecessary); the recorder's whole per-step cost is six
-            # [cap] selects + one masked increment
-            oh = sel.row_onehot(cfg.trace_cap, slot) & rec_w
+            # [bucket] selects + one masked increment
+            oh = sel.row_onehot(cfg.trace_cap_bucket, slot) & rec_w
 
             def ringput(col, v):
                 return jnp.where(oh, v.astype(col.dtype), col)

@@ -193,11 +193,24 @@ def _parse_dur(s: str) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
-    """Static (compile-time) simulation configuration.
+    """Simulation configuration — split into a STRUCTURAL signature and
+    DYNAMIC knobs (DESIGN §10 has the full field table).
 
-    Everything here shapes the XLA program: changing any field recompiles.
-    Dynamic knobs (current loss rate, latency range, clog matrix) live in
-    SimState and can change mid-run via supervisor ops.
+    Structural fields shape/lower the XLA program: `n_nodes`,
+    `event_capacity`, `payload_words`, `table_dtype`, `emission_write`,
+    `collect_stats`, `trace_cap`'s power-of-two BUCKET, and the static
+    jitter GATE (`net.op_jitter_max > 0`). Only these key a compile
+    (`structural_signature()` — the `compile.PROGRAM_CACHE` key), so
+    Runtimes differing in anything else share executables.
+
+    Dynamic knobs become traced operands carried in SimState: `time_limit`
+    (SimState.tlimit; `set_time_limit` / MADSIM_TEST_TIME_LIMIT), the
+    NetConfig scalars (loss/lat_lo/lat_hi/jitter; supervisor ops and
+    `apply_net_override` retune them), and `trace_cap`'s exact value
+    within its bucket (SimState.trace_cap masks the ring down). They
+    still change TRAJECTORIES — `hash()` covers every field, because the
+    repro contract needs the config that actually ran — they just no
+    longer cost a recompile.
     """
 
     n_nodes: int
@@ -251,10 +264,35 @@ class SimConfig:
         if self.table_dtype == "int16":
             assert self.n_nodes < 2**15, "int16 t_node caps nodes at 32767"
 
+    @property
+    def trace_cap_bucket(self) -> int:
+        """Ring capacity as COMPILED: trace_cap rounded up to the next
+        power of two (0 stays 0 — recorder compiled out). The exact
+        trace_cap value rides dynamically in SimState and masks the ring
+        down, so sweeping trace_cap within one bucket shares one
+        executable; rows past trace_cap are never written."""
+        from ..compile.signature import next_pow2
+        return next_pow2(self.trace_cap)
+
+    def structural_signature(self) -> tuple:
+        """The shape/lowering-affecting slice of this config — what keys
+        a step-program compile (`compile.PROGRAM_CACHE`). Two configs
+        with equal signatures trace to the same program; their dynamic
+        knobs (time_limit, NetConfig scalar values, exact trace_cap)
+        ride as operands. `emission_write` stays raw here — 'auto'
+        resolves per backend at trace time, and the cache keys the
+        backend separately."""
+        return ("simconfig-v1", self.n_nodes, self.event_capacity,
+                self.payload_words, self.table_dtype, self.emission_write,
+                bool(self.collect_stats), self.trace_cap_bucket,
+                self.net.op_jitter_max > 0)
+
     def hash(self) -> str:
         """Stable 8-hex-digit config hash, printed on test failure so a repro
         requires the same config — madsim sim::config::Config::hash
         (config.rs:27-31) and the MADSIM_CONFIG_HASH echo (macros lib.rs:189).
+        Covers EVERY field (dynamic knobs change trajectories even though
+        they no longer key compiles — replay domain != compile domain).
         """
         blob = json.dumps(dataclasses.asdict(self), sort_keys=True)
         return hashlib.sha256(blob.encode()).hexdigest()[:8]

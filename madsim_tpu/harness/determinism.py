@@ -14,15 +14,19 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..utils.hashing import fingerprint
+from ..utils.hashing import batch_fingerprints as vfp
 
 
 def find_divergence(rt, seed: int, max_steps: int, probe: int = 64):
     """Run seed twice in lockstep; return None if identical, else a dict
     {step, event} locating the first step whose post-state fingerprints
     differ (the take-rand-log/check panic analog, with the event attached).
+
+    Shares compiled programs twice over: the chunk runner comes from the
+    Runtime (which resolves through `compile.PROGRAM_CACHE`), and the
+    fingerprint jit is the process-level one in utils/hashing — a
+    divergence hunt no longer pays its own compiles.
     """
-    vfp = jax.jit(jax.vmap(fingerprint))
     runner = rt._run_chunk[True]
 
     def keep(s):

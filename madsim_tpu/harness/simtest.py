@@ -95,6 +95,11 @@ def run_seeds(rt: Runtime, seeds, max_steps: int, chunk: int = 512,
               net_override=None, time_limit_override=None):
     """Run a seed batch to completion; raise SimFailure on the first crashed
     seed (lowest index). Returns the final batched state."""
+    # cross-process compile tier: honor JAX_COMPILATION_CACHE_DIR (what
+    # scripts/ci.sh exports) so cold harness processes reuse warm
+    # executables; no-op when the env var is unset
+    from ..compile.persistent import enable_persistent_cache
+    enable_persistent_cache()
     init = apply_net_override(rt.init_batch(np.asarray(seeds, np.uint32)),
                               net_override, cfg=rt.cfg)
     if time_limit_override:

@@ -13,6 +13,14 @@ blocked on anyway. Record kinds (each a flat JSON-able dict carrying
   round    one explore() round harvested: new_schedules, distinct_total,
            crashes — the per-round coverage growth off the existing
            on-device digest
+  compile  a runner retraced (= a fresh executable was built, modulo
+           persistent-cache compile skips): label (chunk_runner /
+           fused_runner / inject), batch, chunk. Fired by
+           `compile.COMPILE_LOG` — attach an observer with
+           `COMPILE_LOG.attach(obs)` to see WHERE a sweep's
+           getting-to-execution time goes (the compile/ layer's split of
+           trace/lower/compile stage seconds rides in
+           `COMPILE_LOG.snapshot()`)
   done     sweep finished: totals
 
 Dispatch is by attribute, so an observer overrides only the hooks it
@@ -36,6 +44,9 @@ class SweepObserver:
         pass
 
     def on_round(self, rec: dict) -> None:
+        pass
+
+    def on_compile(self, rec: dict) -> None:
         pass
 
     def on_done(self, rec: dict) -> None:
@@ -62,7 +73,7 @@ class JsonlObserver(SweepObserver):
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
 
-    on_chunk = on_compact = on_round = on_done = _emit
+    on_chunk = on_compact = on_round = on_compile = on_done = _emit
 
     def close(self) -> None:
         if self._own:
@@ -92,6 +103,10 @@ class TeeObserver(SweepObserver):
     def on_round(self, rec):
         for o in self.observers:
             o.on_round(rec)
+
+    def on_compile(self, rec):
+        for o in self.observers:
+            o.on_compile(rec)
 
     def on_done(self, rec):
         for o in self.observers:

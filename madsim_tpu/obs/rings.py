@@ -18,6 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.state import TRACE_FIELDS
+from ..utils.hostcopy import owned_host_copy
 
 # record columns = the tr_* schema fields, names sans prefix
 _COLS = tuple(f[3:] for f in TRACE_FIELDS if f.startswith("tr_"))
@@ -56,15 +57,26 @@ def ring_records(state, lane: int = 0) -> dict:
     the sharded `run_fused` fine; only the host-side read is local.
     """
     _require_addressable(state, "ring_records")
-    cols = {k: np.asarray(getattr(state, f"tr_{k}")) for k in _COLS}
+    # OWNED host copies (utils/hostcopy): the returned columns are held
+    # by the caller across later donated runs of the same state buffers —
+    # a zero-copy view would dangle (the PR-2 warm-cache bug class)
+    cols = {k: owned_host_copy(getattr(state, f"tr_{k}")) for k in _COLS}
     pos = np.asarray(state.trace_pos)
     on = np.asarray(state.trace_on)
+    # LOGICAL capacity is the dynamic state operand (cfg.trace_cap);
+    # column length is its power-of-two bucket — rows past cap are
+    # never written (core/step.py), so readers index mod cap only.
+    # States without the operand (pre-bucketing checkpoints, synthetic
+    # fixtures) degrade to column length == capacity.
+    cap_arr = np.asarray(getattr(state, "trace_cap",
+                                 cols["now"].shape[-1]))
     if cols["now"].ndim == 2:          # batched state: select the lane
         cols = {k: v[lane] for k, v in cols.items()}
         pos, on = pos[lane], on[lane]
-    cap = cols["now"].shape[0]
-    if cap == 0:
+        cap_arr = cap_arr[lane] if cap_arr.ndim else cap_arr
+    if cols["now"].shape[0] == 0:
         raise ValueError("trace ring is compiled out (cfg.trace_cap == 0)")
+    cap = int(cap_arr)
     if not bool(on):
         raise ValueError(
             f"lane {lane} was not sampled (init_batch trace_lanes mask); "

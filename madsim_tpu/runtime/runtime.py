@@ -19,12 +19,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..compile.cache import COMPILE_LOG, PROGRAM_CACHE
+from ..compile.signature import runtime_signature
 from ..core import prng
 from ..core import types as T
 from ..core.api import Program
 from ..core.state import SimState, init_state
 from ..core.step import make_step
-from ..utils.hashing import fingerprint
+from ..utils.hashing import batch_fingerprints
+from ..utils.hostcopy import owned_host_copy
 from .scenario import Scenario
 
 
@@ -52,6 +55,13 @@ class Runtime:
         appended automatically if the scenario has none (set_time_limit
         analog, runtime/mod.rs:175-177).
       invariant: optional global safety check f(state) -> (bad, code).
+      share_programs: resolve this Runtime's jitted runners through the
+        process-level `compile.PROGRAM_CACHE` (keyed on the structural
+        signature — see compile/signature.py), so structurally-identical
+        Runtimes share one trace+compile per (batch shape, chunk length).
+        False restores private per-instance jits (the fresh-compile
+        control used by the cache-correctness tests and
+        `bench.py --mode compile_ab`).
     """
 
     def __init__(self, cfg: T.SimConfig, programs: Sequence[Program],
@@ -60,7 +70,8 @@ class Runtime:
                  invariant: Callable | None = None,
                  persist: Any = None,
                  halt_when: Callable | None = None,
-                 extensions: Sequence = ()):
+                 extensions: Sequence = (),
+                 share_programs: bool = True):
         self.cfg = cfg
         self.programs = list(programs)
         self.state_spec = state_spec
@@ -73,7 +84,25 @@ class Runtime:
                                self.state_spec, invariant, persist=persist,
                                halt_when=halt_when,
                                extensions=self.extensions)
+        # structural signature: programs/specs/invariants are frozen into
+        # the key AT CONSTRUCTION — mutating a program object afterwards
+        # was already unsupported (the first run bakes the trace); with
+        # sharing it would alias another Runtime's executable, so the
+        # freeze formalizes the contract
+        self._sig = (runtime_signature(cfg, self.programs, self.node_prog,
+                                       self.state_spec, invariant, persist,
+                                       halt_when, self.extensions)
+                     if share_programs else None)
         self.set_scenario(scenario)
+
+    def _shared(self, kind, build):
+        """Resolve a jitted runner: through the process-level ProgramCache
+        when sharing is on (a hit means another structurally-identical
+        Runtime already built — and possibly compiled — it), else build
+        privately."""
+        if self._sig is None:
+            return build()
+        return PROGRAM_CACHE.get((self._sig, kind), build)
 
     def set_scenario(self, scenario: Scenario | None) -> None:
         """Swap the scheduled supervisor script WITHOUT recompiling.
@@ -199,8 +228,9 @@ class Runtime:
     # ------------------------------------------------------------------
     @functools.cached_property
     def _run_chunk(self):
-        return {True: self._compile_chunk(True),
-                False: self._compile_chunk(False)}
+        return {c: self._shared(("chunk", c),
+                                functools.partial(self._compile_chunk, c))
+                for c in (True, False)}
 
     def _compile_chunk(self, collect_events: bool):
         # scan over steps of the vmapped step: one XLA program advances the
@@ -208,6 +238,13 @@ class Runtime:
         vstep = jax.vmap(self._step)
 
         def run(state: SimState, chunk_len: int):
+            # traced-Python side effect: fires once per retrace, i.e. per
+            # fresh executable (modulo persistent-cache compile skips) —
+            # the compile counter CI prints and tests assert on
+            COMPILE_LOG.note_trace("chunk_runner", collect=collect_events,
+                                   chunk=chunk_len,
+                                   batch=int(state.halted.shape[0]))
+
             def body(s, _):
                 s, rec = vstep(s)
                 return s, (rec if collect_events else 0)
@@ -229,9 +266,15 @@ class Runtime:
 
         `n_chunks` is a traced operand (no recompile per sweep length);
         `chunk_len` is static (scan length must be)."""
+        return self._shared("fused", self._compile_fused)
+
+    def _compile_fused(self):
         vstep = jax.vmap(self._step)
 
         def run(state: SimState, n_chunks, chunk_len: int):
+            COMPILE_LOG.note_trace("fused_runner", chunk=chunk_len,
+                                   batch=int(state.halted.shape[0]))
+
             def chunk_body(s, _):
                 s, _ = vstep(s)
                 return s, None
@@ -315,6 +358,12 @@ class Runtime:
             done += chunk
             k += 1
             if collect_events:
+                # np.asarray (zero-copy where possible) is safe here:
+                # records are runner OUTPUTS and are never donated —
+                # only the threaded state is — and the view's base
+                # reference keeps the buffer alive. The owned-copy rule
+                # (utils/hostcopy) applies to stashes of soon-to-be-
+                # donated state, like run_compacting's.
                 events.append(jax.tree.map(np.asarray, recs))
             all_halted = bool(state.halted.all())
             if observer is not None:
@@ -414,16 +463,12 @@ class Runtime:
                     pad_idx = np.nonzero(halted)[0][:target - live]
                     keep = np.concatenate([live_idx, pad_idx])
                     drop = np.setdiff1d(np.arange(n), keep)
-                    # OWNED copies, not np.asarray views: on the CPU
-                    # backend np.asarray of a device array can be
-                    # zero-copy, and the next runner() call DONATES the
-                    # state buffers — a stashed view would then read
-                    # recycled memory (observed as 0x01010101 garbage
-                    # when the chunk executable came from the persistent
-                    # compile cache, whose buffer lifetimes differ from
-                    # the fresh-compile path)
-                    host = jax.tree.map(
-                        lambda a: np.array(a, copy=True), state)
+                    # OWNED copies, not np.asarray views: the next
+                    # runner() call DONATES the state buffers — a
+                    # stashed view would read recycled memory (the PR-2
+                    # warm-compile-cache bug class; utils/hostcopy.py
+                    # documents it)
+                    host = owned_host_copy(state)
                     stash.append((orig_idx[drop],
                                   jax.tree.map(lambda a: a[drop], host)))
                     state = jax.tree.map(lambda a: jnp.asarray(a[keep]), host)
@@ -449,7 +494,7 @@ class Runtime:
                 wall_s=wall))
         # merge: stashed lanes + final state, back in original order
         # (owned copies for the same donation-aliasing reason as above)
-        final_host = jax.tree.map(lambda a: np.array(a, copy=True), state)
+        final_host = owned_host_copy(state)
         parts = stash + [(orig_idx, final_host)]
         order = np.concatenate([p[0] for p in parts])
         inv = np.argsort(order)
@@ -496,6 +541,9 @@ class Runtime:
     # run() chunks.
     @functools.cached_property
     def _inject(self):
+        return self._shared("inject", self._compile_inject)
+
+    def _compile_inject(self):
         from ..core import types as Ty
         from ..ops.select import first_k_free
 
@@ -567,8 +615,10 @@ class Runtime:
 
     # ------------------------------------------------------------------
     def fingerprints(self, state: SimState) -> np.ndarray:
-        """uint32 fingerprint per trajectory (determinism checks)."""
-        return np.asarray(jax.jit(jax.vmap(fingerprint))(state))
+        """uint32 fingerprint per trajectory (determinism checks). Uses
+        the ONE process-level jitted fingerprint (utils/hashing): the old
+        per-call `jax.jit(jax.vmap(...))` retraced on every invocation."""
+        return np.asarray(batch_fingerprints(state))
 
     def check_determinism(self, seed: int, max_steps: int,
                           net_override=None) -> bool:

@@ -60,3 +60,18 @@ def fingerprint(state) -> jax.Array:
         lh = jnp.sum(w * mix, dtype=jnp.uint32) if w.shape[0] else jnp.uint32(0)
         h = (h ^ lh) * FNV_PRIME
     return h
+
+
+# ONE process-level jitted batched fingerprint, shared by every Runtime
+# and by find_divergence: jax.jit caches by FUNCTION IDENTITY first, so
+# the old per-call `jax.jit(jax.vmap(fingerprint))` retraced on every
+# invocation — a compile per fingerprints() call. A module-level jit
+# retraces only per state structure/shape (which is the granularity
+# executables genuinely differ at).
+_BATCH_FP = jax.jit(jax.vmap(fingerprint))
+
+
+def batch_fingerprints(state) -> jax.Array:
+    """uint32[B] fingerprints of a batched state (device array; callers
+    np.asarray it). Shared compiled entry across all Runtimes."""
+    return _BATCH_FP(state)

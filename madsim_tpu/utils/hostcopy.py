@@ -1,0 +1,31 @@
+"""Owned host copies of device buffers — the donation-aliasing guard.
+
+The PR-2 bug class this exists for: on the CPU backend `np.asarray` of a
+device array can be ZERO-COPY — a view into the device buffer. If that
+buffer is later DONATED (`donate_argnums`) to another executable, the
+"stashed" view reads recycled memory. The failure is timing-dependent and
+cache-dependent: it was first observed as 0x01010101 garbage lanes only
+when the chunk executable came from the warm persistent compile cache,
+whose buffer lifetimes differ from the fresh-compile path — so with the
+shared `ProgramCache` and the persistent tier both live, every host-side
+stash that outlives the next runner call MUST own its memory.
+
+Rule (DESIGN §10): `np.asarray` is fine for values consumed before the
+next jitted call on the same state (reductions, immediate reads);
+anything held ACROSS a runner invocation — compaction stashes, ring
+readers' returned columns, merge paths — goes through `owned_host_copy`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def owned_host_copy(tree: Any) -> Any:
+    """Deep host copy of a pytree: every leaf becomes a numpy array that
+    OWNS its memory (np.array(copy=True)) — safe to hold across later
+    donated executions of the source buffers."""
+    return jax.tree.map(lambda a: np.array(a, copy=True), tree)

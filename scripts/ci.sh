@@ -4,11 +4,13 @@
 # real tokio; here the sim tier is the vectorized engine and the realworld
 # tier drives real sockets/wall-clock through the same Programs).
 #
-# Usage: scripts/ci.sh [fast|full]
+# Usage: scripts/ci.sh [fast|full] [--compile-smoke]
 #   fast (default)  sim tier minus the long chaos sweeps, then the
 #                   realworld tier serially (wall-clock pacing breaks
 #                   under CPU contention — see pytest.ini). Green in a few
-#                   minutes warm-cached on a 1-core box.
+#                   minutes warm-cached on a 1-core box. With
+#                   --compile-smoke, also asserts the shared step-program
+#                   cache (two structurally-equal configs -> 1 compile).
 #   full            everything: whole suite, a MADSIM_TEST_CHECK_DETERMINISM
 #                   re-run of @simtest workloads (the reference's
 #                   determinism-check-by-replay mode, macros lib.rs:160-186),
@@ -16,6 +18,14 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 tier=${1:-fast}
+
+# Persistent compile cache (DESIGN §10): a workspace-local dir shared by
+# both lanes, so a cold CI process reuses warm XLA executables instead of
+# recompiling every structurally-known step program. Content-keyed — it
+# can only skip the compile stage, never change results.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+# pytest prints the compile-counter summary at suite end (tests/conftest.py)
+export MADSIM_COMPILE_SUMMARY="${MADSIM_COMPILE_SUMMARY:-1}"
 
 case "$tier" in
   fast)
@@ -28,6 +38,12 @@ case "$tier" in
     # ring that exports as valid Chrome-trace JSON, and the exporter's
     # event counts must agree with the engine's own fired counts
     python bench.py --obs-smoke
+    if [[ "${2:-}" == "--compile-smoke" ]]; then
+      # shared step-program cache smoke: two structurally-equal configs
+      # must cost exactly one retrace and stay bitwise-equal to a
+      # fresh-compile control
+      python bench.py --compile-smoke
+    fi
     ;;
   full)
     python -m pytest tests/ -q
@@ -38,13 +54,14 @@ case "$tier" in
     # multi-chip sharding compiles + executes on a virtual 8-device mesh
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
-    # seconds-scale bench self-test: the measurement paths (incl. the
-    # native baseline twin) must not rot — the reference's ci.yml runs
-    # its criterion benches the same way
+    # seconds-scale bench self-tests: the measurement paths (incl. the
+    # native baseline twin and the shared-compile cache) must not rot —
+    # the reference's ci.yml runs its criterion benches the same way
     python bench.py --smoke
+    python bench.py --compile-smoke
     ;;
   *)
-    echo "usage: scripts/ci.sh [fast|full]" >&2
+    echo "usage: scripts/ci.sh [fast|full] [--compile-smoke]" >&2
     exit 2
     ;;
 esac

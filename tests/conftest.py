@@ -19,10 +19,28 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: most suite wall-clock is XLA recompiles of
-# near-identical step programs (every test builds a Runtime with its own
-# static shapes). Caching them across runs cuts the suite from ~12min to
-# the actual execution time.
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+# Persistent compilation cache (DESIGN §10): most suite wall-clock is XLA
+# recompiles of near-identical step programs. Two tiers cut it: the
+# process-level PROGRAM_CACHE (madsim_tpu/compile) shares executables
+# across Runtime constructions WITHIN the run, and this on-disk cache
+# reuses them ACROSS runs. scripts/ci.sh exports JAX_COMPILATION_CACHE_DIR
+# (workspace-local); default to the same path for bare pytest runs.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(__file__), "..",
+                                ".jax_cache")))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Print the compile-counter summary at suite end (scripts/ci.sh sets
+    MADSIM_COMPILE_SUMMARY=1): how many retraces the suite paid, by
+    runner label, plus program-cache hit rates and jax stage seconds."""
+    if not os.environ.get("MADSIM_COMPILE_SUMMARY"):
+        return
+    try:
+        from madsim_tpu.compile.cache import COMPILE_LOG
+        print(f"\n{COMPILE_LOG.summary()}")
+    except Exception:  # noqa: BLE001 - reporting must never fail the suite
+        pass

@@ -384,7 +384,8 @@ def _fake_state(cap, pos, on=True, batch=None):
     cols = {f"tr_{k}": vals.copy() for k in
             ("now", "step", "kind", "node", "src", "tag")}
     st = SimpleNamespace(trace_pos=np.int32(pos), trace_on=np.bool_(on),
-                         **cols)
+                         trace_cap=np.int32(cap),   # the dynamic capacity
+                         **cols)                    # operand (DESIGN §10)
     if batch is not None:
         for k, v in vars(st).items():
             setattr(st, k, np.stack([np.asarray(v)] * batch))
