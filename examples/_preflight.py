@@ -19,5 +19,8 @@ def ensure_safe_backend():
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return          # no tunnel pin: nothing can wedge
     from bench import _force_cpu_inprocess, _tpu_alive
-    if not _tpu_alive():
+    # retry once: transient tunnel flakes are common and cheap to re-probe
+    # (a WEDGED verdict is disk-cached by _tpu_alive, so the second probe
+    # of a truly dead tunnel costs nothing)
+    if not (_tpu_alive() or _tpu_alive()):
         _force_cpu_inprocess()

@@ -40,6 +40,7 @@ from .parallel.explore import explore
 from .parallel.stats import schedule_representatives, summarize
 from .runtime.runtime import Runtime
 from .runtime.scenario import Scenario
+from .search import Corpus, KnobPlan, fuzz, pct_sweep, with_prio_nudge
 
 __version__ = "0.1.0"
 
@@ -50,6 +51,7 @@ __all__ = [
     "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
     "explore", "minimize_scenario", "summarize", "schedule_representatives",
     "find_divergence",
+    "fuzz", "Corpus", "KnobPlan", "pct_sweep", "with_prio_nudge",
     "SweepObserver", "JsonlObserver", "ProgressObserver", "ring_records",
     "export_chrome_trace",
 ]

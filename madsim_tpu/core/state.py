@@ -89,6 +89,20 @@ class SimState:
     jitter: jax.Array       # int32 ticks — per-op micro-jitter bound
                             # (NetConfig.op_jitter_max; net/mod.rs:151-156)
 
+    # --- schedule search (search/pct.py) ----------------------------------
+    prio_nudge: jax.Array   # int32 — PCT-style priority-perturbation point.
+                            # 0 (the default) leaves the scheduler's random
+                            # tie-break untouched and is BIT-IDENTICAL to a
+                            # build without the hook; any nonzero value
+                            # replaces the tie-break among earliest-deadline
+                            # slots with a deterministic priority order keyed
+                            # on (nudge, slot identity) — one nudge = one
+                            # tie-breaking policy, so a fuzzer sweeps
+                            # scheduler decisions as a DYNAMIC knob (no
+                            # recompile, step.py §1). Part of the replay
+                            # domain: it changes trajectories, so it rides
+                            # in fingerprints, unlike the trace ring.
+
     # --- stats (NetSim::stat analog, network.rs:82-85) --------------------
     msg_sent: jax.Array
     msg_delivered: jax.Array
@@ -164,6 +178,7 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         lat_lo=jnp.asarray(cfg.net.send_latency_min, i32),
         lat_hi=jnp.asarray(cfg.net.send_latency_max, i32),
         jitter=jnp.asarray(cfg.net.op_jitter_max, i32),
+        prio_nudge=jnp.asarray(0, i32),
         msg_sent=jnp.asarray(0, i32),
         msg_delivered=jnp.asarray(0, i32),
         msg_dropped=jnp.asarray(0, i32),

@@ -128,6 +128,30 @@ def make_step(
         dmin, at_min, any_ev = sel.min_deadline(s.t_deadline, eligible,
                                                 T.T_INF)
         idx, picked = sel.masked_choice(k_sched, at_min)
+        u32 = jnp.uint32
+
+        # ---- PCT-style priority perturbation (search/pct.py) -------------
+        # When the per-lane `prio_nudge` operand is nonzero, the uniform
+        # tie-break above is REPLACED by a deterministic priority argmax
+        # over the earliest-deadline candidates: each slot's priority is a
+        # hash of (nudge, slot identity), so one nudge value = one
+        # tie-breaking policy, and sweeping nudges enumerates scheduler
+        # decisions the way PCT sweeps priority assignments. Contract:
+        #  - nudge == 0 is bit-identical to the hook's absence (the
+        #    `where` keeps the masked_choice pick, and k_sched was already
+        #    consumed either way, so the PRNG stream never shifts);
+        #  - nudge is DYNAMIC state — mutating it never recompiles.
+        prio = (s.t_tag.astype(u32) * u32(0x9E3779B1)
+                ^ s.t_node.astype(u32) * u32(0x85EBCA77)
+                ^ jnp.arange(cfg.event_capacity,
+                             dtype=jnp.int32).astype(u32) * u32(0xC2B2AE3D)
+                ^ s.prio_nudge.astype(u32) * u32(0x27D4EB2F))
+        prio = (prio ^ (prio >> 15)) * u32(0x2C1B3C6D)
+        # `| 1` floors candidate priorities above the masked-out 0, so the
+        # argmax can only land on an at_min slot whenever one exists
+        nudged = jnp.argmax(jnp.where(at_min, prio | u32(1),
+                                      u32(0))).astype(jnp.int32)
+        idx = jnp.where(s.prio_nudge != 0, nudged, idx)
         valid = picked & any_ev & live
 
         ev_kind = jnp.where(valid, sel.take1(s.t_kind, idx), T.EV_FREE)
@@ -141,7 +165,6 @@ def make_step(
         # a running FNV-style mix. Pure VPU arithmetic, consumes no
         # randomness, so it cannot perturb replay; distinct interleavings
         # yield distinct hashes even when terminal states coincide.
-        u32 = jnp.uint32
         # two independent lanes (64 effective bits — see state.py): same
         # event fields, different multiplier assignment per lane, different
         # FNV-style folding primes

@@ -59,10 +59,14 @@ class ProgressObserver:
         self.stream.write("\n")
 
     def on_round(self, rec):
+        # explore() rounds and fuzz() rounds share the schema; fuzz adds
+        # corpus_size (and kind="fuzz_round")
+        corpus = (f"  corpus {rec['corpus_size']}"
+                  if "corpus_size" in rec else "")
         self._show(
             f"round {rec['round']:>3}  +{rec['new_schedules']} new "
             f"schedules ({rec['distinct_total']} distinct)  "
-            f"crashes {rec['crashes']}", force=True)
+            f"crashes {rec['crashes']}{corpus}", force=True)
 
     def on_done(self, rec):
         parts = [f"done: {rec.get('steps_done', rec.get('seeds_run', 0))} "

@@ -1,0 +1,19 @@
+"""Coverage-guided schedule search (r9): the subsystem that SEARCHES the
+schedule space instead of sampling it.
+
+  corpus.py   energy-scheduled corpus of knob vectors, deduped by
+              sched_hash coverage
+  mutate.py   the per-lane knob schema + jitted on-device mutation engine
+  pct.py      PCT-style tie-break perturbation (SimState.prio_nudge)
+  fuzz.py     the pipelined loop-until-dry driver
+
+See DESIGN.md §11 "Search discipline".
+"""
+
+from .corpus import Corpus
+from .fuzz import fuzz
+from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
+from .pct import pct_sweep, with_prio_nudge
+
+__all__ = ["Corpus", "KnobPlan", "fuzz", "pct_sweep", "with_prio_nudge",
+           "OP_NAMES", "N_MUT_OPS"]

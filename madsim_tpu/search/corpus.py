@@ -1,0 +1,116 @@
+"""The fuzz corpus: interesting knob vectors, energy-scheduled.
+
+AFL keeps inputs that reached new edges; the schedule fuzzer keeps knob
+vectors whose lane produced a `sched_hash` never seen before — the corpus
+is KEYED AND DEDUPED by the coverage digest itself (one entry per distinct
+u64 schedule hash), so it can only grow when coverage grows. Host-side and
+numpy-only: the corpus is bookkeeping between device rounds, sized in
+kilobytes, and never on the hot path (corpus work overlaps device compute
+in the pipelined fuzz loop exactly like explore()'s dedup).
+
+Energy rules (the AFL-style scheduler, simplified to what the batched
+setting needs):
+  - admission energy 1.0; a lane that CRASHED enters with 3.0 (crash
+    neighborhoods are where more crashes live);
+  - a parent whose mutant discovered a new schedule is rewarded
+    (energy x1.5, capped) — productive regions get more mutation budget;
+  - every round all energies decay x`decay` toward a floor, so stale
+    entries fade instead of starving newcomers;
+  - `schedule()` samples parents with probability proportional to energy,
+    and keeps `fresh_frac` of each batch on the UNMUTATED base knobs — an
+    exploration floor so the corpus never traps the sweep in one basin.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .mutate import KnobPlan
+
+
+class Corpus:
+    def __init__(self, plan: KnobPlan, rng=None, max_entries: int = 4096,
+                 fresh_frac: float = 0.125, decay: float = 0.97,
+                 reward: float = 1.5, energy_cap: float = 8.0):
+        self.plan = plan
+        self.rng = rng if rng is not None else np.random.default_rng(0)
+        self.max_entries = int(max_entries)
+        self.fresh_frac = float(fresh_frac)
+        self.decay = float(decay)
+        self.reward = float(reward)
+        self.energy_cap = float(energy_cap)
+        self.entries: list[dict] = []   # slot-stable: eviction replaces
+        self._seen: set[int] = set()    # every hash ever admitted (dedupe)
+        self.crash_codes: set[int] = set()
+        # parent attribution is by monotonic entry id, not slot index:
+        # schedule() hands out ids and observe() rewards through this map,
+        # so an eviction (same round or, under the pipelined loop, a later
+        # one) can never hand a stale parent's reward to the slot's fresh
+        # occupant — the reward just finds nobody
+        self._next_id = 0
+        self._by_id: dict[int, dict] = {}
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    # ------------------------------------------------------------------
+    def observe(self, knobs_batch, seeds, hashes_u64, crashed, codes,
+                parent_ids, round_no: int) -> dict:
+        """Fold one harvested round into the corpus. `knobs_batch` is the
+        HOST knob batch that ran, `hashes_u64` the per-lane schedule
+        hashes, `parent_ids` the corpus entry id each lane mutated from
+        (schedule()'s ids; -1 for base/bootstrap lanes). Returns
+        admission stats."""
+        new = 0
+        new_crash_codes = []
+        for e in self.entries:
+            e["energy"] = max(0.05, e["energy"] * self.decay)
+        for i in range(len(seeds)):
+            h = int(hashes_u64[i])
+            hit_crash = bool(crashed[i])
+            if hit_crash and int(codes[i]) not in self.crash_codes:
+                self.crash_codes.add(int(codes[i]))
+                new_crash_codes.append(int(codes[i]))
+            if h in self._seen:
+                continue
+            self._seen.add(h)
+            new += 1
+            entry = dict(id=self._next_id, hash=h, seed=int(seeds[i]),
+                         knobs=KnobPlan.lane(knobs_batch, i),
+                         energy=3.0 if hit_crash else 1.0,
+                         round=int(round_no),
+                         crash_code=int(codes[i]) if hit_crash else 0)
+            self._next_id += 1
+            self._by_id[entry["id"]] = entry
+            if len(self.entries) < self.max_entries:
+                self.entries.append(entry)
+            else:                        # replace the coldest slot
+                j = int(np.argmin([e["energy"] for e in self.entries]))
+                del self._by_id[self.entries[j]["id"]]
+                self.entries[j] = entry
+            parent = self._by_id.get(int(parent_ids[i]))
+            if parent is not None:
+                parent["energy"] = min(
+                    self.energy_cap, parent["energy"] * self.reward)
+        return dict(new=new, size=len(self.entries),
+                    new_crash_codes=new_crash_codes)
+
+    # ------------------------------------------------------------------
+    def schedule(self, batch: int):
+        """Pick the next round's parents: energy-weighted sampling with
+        replacement, with a `fresh_frac` floor of unmutated base lanes.
+        Returns (host knob batch [batch, ...], parent entry ids [batch],
+        -1 for base lanes)."""
+        ids = np.full(batch, -1, np.int64)
+        out = [self.plan.base_knobs() for _ in range(batch)]
+        if self.entries:
+            en = np.asarray([e["energy"] for e in self.entries])
+            p = en / en.sum()
+            pick = self.rng.choice(len(self.entries), size=batch, p=p)
+            mutate_lane = self.rng.random(batch) >= self.fresh_frac
+            for i in range(batch):
+                if mutate_lane[i]:
+                    ent = self.entries[int(pick[i])]
+                    out[i] = ent["knobs"]
+                    ids[i] = ent["id"]
+        return KnobPlan.stack(out), ids

@@ -1,0 +1,186 @@
+"""The coverage-guided fuzz loop: mutate -> run -> evaluate, pipelined.
+
+`explore()` (parallel/explore.py) samples the schedule space blindly —
+fresh seeds, one fixed fault script. This driver SEARCHES it: every round
+schedules parents from the corpus (energy-weighted), derives a batch of
+mutants on device (search/mutate.py — zero recompiles, knobs are traced
+operands), runs them as one fused dispatch, and admits lanes that reached
+never-seen `sched_hash` coverage back into the corpus. Loop-until-dry,
+exactly like explore(): the sweep stops when `dry_rounds` consecutive
+rounds add no new schedule.
+
+Pipelining (the Podracer discipline, PAPERS.md, same shape as explore()):
+round r+1's mutate+init+run is DISPATCHED before the host blocks on round
+r's harvest, so corpus bookkeeping overlaps device compute. The price is
+one round of corpus staleness — round r+1's parents are scheduled from
+the corpus as of round r-1 — which only delays (never loses) coverage
+feedback; `pipeline=False` restores the fully-serial AFL loop.
+
+Crashes are harvested, never aborted on: every distinct crash code keeps
+its first full repro handle — (seed, knob vector) — because a mutated
+lane's behavior is NOT reproducible from the seed alone. `minimize=True`
+auto-shrinks each repro's fault rows through `harness.minimize`
+(batched ddmin, knob domain — no slot-layout verification gap).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from ..parallel import stats
+from .corpus import Corpus
+from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
+
+
+def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
+         dry_rounds: int = 3, base_seed: int = 0, chunk: int = 512,
+         pipeline: bool = True, fused: bool = True, dup_slots: int = 2,
+         havoc: int = 3, fresh_frac: float = 0.125, rng_seed: int = 0,
+         observer=None, minimize: bool = False, corpus: Corpus | None = None):
+    """Coverage-guided schedule fuzzing over `rt`'s dynamic fault knobs.
+
+    Round 0 is a blind bootstrap (base knobs, fresh seeds — one explore()
+    round) that seeds the corpus; rounds 1.. run mutants. Every lane gets
+    a FRESH seed (seed randomness and knob search compose: the knob vector
+    moves the fault model, the seed moves the tie-breaks/timeouts within
+    it), so a repro is always the (seed, knobs) pair.
+
+    Args beyond explore()'s: dup_slots (spare event rows for the
+    row-duplicate operator), havoc (stacked mutations per lane), fresh_frac
+    (exploration floor of unmutated lanes per round), rng_seed (corpus
+    scheduling + mutation randomness — the whole campaign is replayable),
+    minimize (auto-shrink each crash repro's fault rows), corpus (pass a
+    prior campaign's corpus to continue it).
+
+    observer: obs.metrics.SweepObserver — `on_round` records of kind
+    "fuzz_round" (explore's round schema + corpus_size/new_crash_codes),
+    `on_done` with the final result; hooks ride the harvest the loop
+    already blocks on.
+
+    Returns a dict — explore()'s schema (seeds_run/rounds/
+    distinct_schedules/new_per_round/saturated/crashes/
+    crash_first_seed_by_code — that key keeps explore()'s contract of
+    SEED-ALONE repro handles, so it only records crashes from unmutated
+    bootstrap lanes; a crash first seen on a mutated lane appears only in
+    crash_repros, whose (seed, knobs) pair is its real handle) plus:
+      crash_repros      {code: {seed, round, knobs, script}} full handles
+      corpus_size       corpus entries at the end
+      mutation_ops      {operator name: times applied}
+      minimized         {code: minimize_knobs info} when minimize=True
+    """
+    plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
+    corpus = corpus if corpus is not None else Corpus(
+        plan, rng=np.random.default_rng(rng_seed), fresh_frac=fresh_frac)
+    master = jax.random.PRNGKey(np.uint32(rng_seed ^ 0x5EED5EED))
+    op_hist = np.zeros(N_MUT_OPS, np.int64)
+
+    def launch(r):
+        """Schedule + mutate + dispatch one round without blocking on
+        results (run_fused and the knob kernels are all async)."""
+        seeds = np.arange(base_seed + r * batch,
+                          base_seed + (r + 1) * batch, dtype=np.uint32)
+        if r == 0 or len(corpus) == 0:
+            knobs_dev = {k: v for k, v in plan.base_batch(batch).items()}
+            ids = np.full(batch, -1, np.int64)
+            hist = None
+        else:
+            parents, ids = corpus.schedule(batch)
+            knobs_dev, hist = plan.mutate(parents, jax.random.fold_in(
+                master, np.uint32(r)), havoc=havoc)
+        state = plan.apply(rt.init_batch(seeds), knobs_dev)
+        if fused:
+            state = rt.run_fused(state, max_steps, chunk)
+        else:
+            state, _ = rt.run(state, max_steps, chunk)
+        return seeds, ids, knobs_dev, hist, state
+
+    def harvest(launched):
+        """Block on one round. Transfers the [B] hash/crash lanes plus
+        the knob batch (kilobytes — the corpus needs per-lane
+        attribution, unlike explore()'s O(distinct) digest)."""
+        seeds, ids, knobs_dev, hist, state = launched
+        knobs_host = {k: np.asarray(v) for k, v in knobs_dev.items()}
+        hashes = stats.sched_hash_u64(state)
+        if hist is not None:
+            op_hist[:] += np.asarray(hist)
+        return (seeds, ids, knobs_host, hashes,
+                np.asarray(state.crashed), np.asarray(state.crash_code),
+                hist is not None)
+
+    seen: set[int] = set()
+    crashes: dict[int, int] = {}
+    repros: dict[int, dict] = {}
+    n_crashed = 0
+    new_per_round: list[int] = []
+    dry = 0
+    rounds = 0
+    speculate = pipeline and fused    # chunked runs block per chunk anyway
+    t0 = time.perf_counter()
+    pending = launch(0) if max_rounds > 0 else None
+    for r in range(max_rounds):
+        nxt = (launch(r + 1) if speculate and r + 1 < max_rounds else None)
+        (seeds, ids, knobs_host, hashes, crashed, codes,
+         mutated) = harvest(pending)
+        rounds += 1
+        cstats = corpus.observe(knobs_host, seeds, hashes, crashed, codes,
+                                ids, r)
+        for i in np.nonzero(crashed)[0]:
+            c = int(codes[i])
+            if not mutated:     # seed-alone handles: bootstrap lanes only
+                crashes.setdefault(c, int(seeds[i]))
+            if c not in repros:
+                kn = KnobPlan.lane(knobs_host, int(i))
+                repros[c] = dict(seed=int(seeds[i]), round=r, knobs=kn,
+                                 script=plan.to_scenario(kn).describe())
+        n_crashed += int(crashed.sum())
+        fresh = set(hashes.tolist()) - seen
+        seen |= fresh
+        new_per_round.append(len(fresh))
+        dry = dry + 1 if not fresh else 0
+        if observer is not None:
+            observer.on_round(dict(
+                kind="fuzz_round", round=rounds, batch=batch,
+                seeds_run=rounds * batch, new_schedules=len(fresh),
+                distinct_total=len(seen), crashes=n_crashed,
+                corpus_size=cstats["size"],
+                new_crash_codes=cstats["new_crash_codes"],
+                dry_rounds=dry, wall_s=time.perf_counter() - t0))
+        if dry >= dry_rounds:
+            break
+        pending = nxt if nxt is not None else (
+            launch(r + 1) if r + 1 < max_rounds else None)
+
+    result = dict(
+        seeds_run=rounds * batch,
+        rounds=rounds,
+        distinct_schedules=len(seen),
+        new_per_round=new_per_round,
+        saturated=dry >= dry_rounds,
+        crash_first_seed_by_code=crashes,
+        crashes=n_crashed,
+        crash_repros=repros,
+        corpus_size=len(corpus),
+        mutation_ops={OP_NAMES[i]: int(op_hist[i])
+                      for i in range(N_MUT_OPS)},
+    )
+    if minimize and repros:
+        from ..harness.minimize import minimize_knobs
+        result["minimized"] = {}
+        for c, rep in repros.items():
+            try:
+                minimal, info = minimize_knobs(rt, plan, rep["knobs"],
+                                               rep["seed"], max_steps,
+                                               chunk)
+                result["minimized"][c] = dict(info, knobs=minimal)
+            except Exception as e:  # noqa: BLE001 - repro handle still stands
+                result["minimized"][c] = dict(error=f"{type(e).__name__}: {e}")
+    if observer is not None:
+        observer.on_done(dict(
+            kind="done", distinct_total=len(seen),
+            wall_s=time.perf_counter() - t0,
+            **{k: v for k, v in result.items()
+               if k not in ("crash_repros", "minimized")}))
+    return result

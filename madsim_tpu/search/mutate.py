@@ -1,0 +1,410 @@
+"""Vectorized mutation engine: per-lane fault-knob vectors, mutated on device.
+
+PR 3's structural/dynamic split made every fault knob a traced operand:
+scenario rows are initial-state DATA (event-table rows), and loss/latency/
+jitter/prio_nudge live in SimState. A mutant is therefore nothing but a
+different initial state — `apply_knobs` rewrites the scenario slots and the
+network scalars of a whole batch in one jitted call, and `mutate` derives a
+batch of mutants from a batch of parents as one jitted program. Zero
+recompiles per campaign: the mutation loop touches only operands.
+
+The knob vector (one lane) — everything the fuzzer may perturb:
+
+  row_time  i32[R]   scenario row fire times (HALT/INIT rows pinned)
+  row_node  i32[R]   row targets (NODE_RANDOM = -1 preserved; reshuffles
+                     stay inside the row's `among=` pool)
+  row_on    bool[R]  row enabled (drop/revive; HALT/INIT pinned on)
+  dup_src   i32[D]   dup slots: clone of scenario row dup_src[d] ...
+  dup_time  i32[D]   ... firing at dup_time[d] (row duplicate operator;
+  dup_on    bool[D]  D spare event-table slots past the scenario segment)
+  loss      f32      packet loss rate
+  lat_lo/hi i32      send-latency range
+  jitter    i32      per-op jitter bound (only on jitter-enabled builds)
+  prio_nudge i32     PCT tie-break policy (core/step.py; 0 = reference)
+
+Bounds are enforced at APPLY time, not trusted from the mutator: times clip
+to [0, tlimit], targets to [-1, N-1] (and only on rows where a target is
+meaningful), loss to [0, 0.99], lat_lo <= lat_hi, pinned rows keep their
+base time and stay enabled — a mutant can explore, never corrupt.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.cache import COMPILE_LOG
+from ..core import prng
+from ..core import types as T
+from ..ops import select as sel
+
+# mutation operator ids (the op histogram in fuzz results uses this order)
+OP_NAMES = ("time_nudge", "target_reshuffle", "row_toggle", "row_dup",
+            "latency_perturb", "loss_perturb", "prio_perturb")
+N_MUT_OPS = len(OP_NAMES)
+
+# ops whose node target is meaningful and pool-restricted (step.py
+# _apply_super: the random-target pool packing); everything else keeps its
+# base node
+_NODE_OPS = (T.OP_KILL, T.OP_RESTART, T.OP_PAUSE, T.OP_RESUME,
+             T.OP_CLOG_NODE, T.OP_UNCLOG_NODE)
+# rows that must never move, drop, or duplicate: HALT carries the
+# time-limit contract, INIT rows interact with the template's deferred-boot
+# bookkeeping (runtime.py _build_template)
+_PINNED_OPS = (T.OP_HALT, T.OP_INIT)
+
+_LAT_CAP = 30_000_000      # 30 simulated seconds — mutation bound, not a
+                           # model limit (deadlines stay far from T_INF)
+_JIT_CAP = 1_000_000
+
+
+@dataclasses.dataclass
+class KnobPlan:
+    """The static half of a fuzz campaign: which knobs exist for this
+    Runtime's scenario, their base values, and the mutability guards.
+    Everything per-shape here is passed to the jitted kernels as an
+    OPERAND, so two campaigns with equal (R, D, N, capacity) shapes share
+    one compiled mutate/apply program."""
+
+    n_init: int                 # scenario rows start at this slot
+    R: int                      # scenario rows (incl. the auto-HALT)
+    D: int                      # dup slots (free event rows past them)
+    N: int                      # nodes
+    payload_words: int
+    jitter_gate: bool           # static build gate (NetConfig.op_jitter_max)
+    base: dict                  # np arrays: time/op/node/src [R], payload [R,P]
+    time_ok: np.ndarray         # bool[R]
+    node_ok: np.ndarray         # bool[R]
+    drop_ok: np.ndarray         # bool[R]
+    pool_ok: np.ndarray         # bool[R, N+1]: pool_ok[r, t+1] — target t
+                                # allowed for row r (t = -1 always allowed)
+    net0: tuple                 # (loss, lat_lo, lat_hi, jitter) base scalars
+
+    @staticmethod
+    def from_runtime(rt, dup_slots: int = 2) -> "KnobPlan":
+        cfg = rt.cfg
+        rows = rt.scenario.build(cfg)
+        R = rows["op"].shape[0]
+        n_init = cfg.n_nodes
+        # dup slots live past the scenario segment; they must exist in the
+        # table BEFORE any emission claims slots (apply runs on the init
+        # state), so capacity-bound them instead of failing
+        D = max(0, min(int(dup_slots), cfg.event_capacity - n_init - R))
+        op = rows["op"]
+        pinned = np.isin(op, _PINNED_OPS)
+        node_ok = np.isin(op, _NODE_OPS)
+        N = cfg.n_nodes
+        pool_ok = np.zeros((R, N + 1), bool)
+        pool_ok[:, 0] = True                       # NODE_RANDOM always legal
+        for r in range(R):
+            pay = rows["payload"][r]
+            if node_ok[r] and pay.any():
+                # pool-restricted random target (31 nodes/word packing):
+                # reshuffles must stay inside the pool — the in-bounds
+                # contract chaos recipes rely on (kill servers, not clients)
+                for t in range(N):
+                    pool_ok[r, t + 1] = bool(
+                        (int(pay[t // 31]) >> (t % 31)) & 1)
+            else:
+                pool_ok[r, 1:] = True
+        return KnobPlan(
+            n_init=n_init, R=R, D=D, N=N, payload_words=cfg.payload_words,
+            jitter_gate=cfg.net.op_jitter_max > 0,
+            base=dict(time=rows["time"].astype(np.int32),
+                      op=op.astype(np.int32),
+                      node=rows["node"].astype(np.int32),
+                      src=rows["src"].astype(np.int32),
+                      payload=rows["payload"].astype(np.int32)),
+            time_ok=~pinned, node_ok=node_ok, drop_ok=~pinned,
+            pool_ok=pool_ok,
+            net0=(float(cfg.net.packet_loss_rate),
+                  int(cfg.net.send_latency_min),
+                  int(cfg.net.send_latency_max),
+                  int(cfg.net.op_jitter_max)))
+
+    # -- knob construction -------------------------------------------------
+    def base_knobs(self) -> dict:
+        """The unmutated knob vector: exactly the Runtime's own scenario
+        and NetConfig (applying it is a no-op modulo slot bookkeeping)."""
+        loss, lo, hi, jit = self.net0
+        return dict(
+            row_time=self.base["time"].copy(),
+            row_node=self.base["node"].copy(),
+            row_on=np.ones(self.R, bool),
+            dup_src=np.zeros(self.D, np.int32),
+            dup_time=np.full(self.D, T.T_INF, np.int32),
+            dup_on=np.zeros(self.D, bool),
+            loss=np.float32(loss), lat_lo=np.int32(lo), lat_hi=np.int32(hi),
+            jitter=np.int32(jit), prio_nudge=np.int32(0))
+
+    def base_batch(self, batch: int) -> dict:
+        return self.stack([self.base_knobs()] * batch)
+
+    @staticmethod
+    def stack(knobs_list) -> dict:
+        return {k: np.stack([kn[k] for kn in knobs_list])
+                for k in knobs_list[0]}
+
+    @staticmethod
+    def lane(knobs_batch, i: int) -> dict:
+        """One lane's knob vector as owned host arrays (corpus entries)."""
+        return {k: np.array(np.asarray(v)[i]) for k, v in knobs_batch.items()}
+
+    def _guards(self) -> dict:
+        return dict(time_ok=jnp.asarray(self.time_ok),
+                    node_ok=jnp.asarray(self.node_ok),
+                    drop_ok=jnp.asarray(self.drop_ok),
+                    pool_ok=jnp.asarray(self.pool_ok))
+
+    # -- the two jitted kernels -------------------------------------------
+    def mutate(self, knobs_batch, key, havoc: int = 3):
+        """Derive a batch of mutants: per lane, `havoc` stacked operators
+        drawn uniformly (the AFL havoc stage, vectorized). `knobs_batch`
+        is host or device arrays [B, ...]; `key` one PRNG key. Returns
+        (device knob batch, int32[N_MUT_OPS] operator histogram).
+        havoc=0 is the degenerate identity (the blind-sampling control:
+        fuzz(havoc=0) reduces to explore() with knob plumbing)."""
+        kb = {k: jnp.asarray(v) for k, v in knobs_batch.items()}
+        if havoc <= 0:
+            return kb, jnp.zeros((N_MUT_OPS,), jnp.int32)
+        return _mutate_batch(kb, key, self._guards(), havoc)
+
+    def apply(self, state, knobs_batch):
+        """Write a knob batch into a batched init state: scenario slots
+        [n_init, n_init+R+D) plus the network/priority scalars. Bounds
+        enforced here (see module docstring). One jitted call; state is
+        not donated (callers may hand the result to donating runners)."""
+        kb = {k: jnp.asarray(v) for k, v in knobs_batch.items()}
+        base = {k: jnp.asarray(v) for k, v in self.base.items()}
+        return _apply_batch(state, kb, base, self._guards(),
+                            self.n_init, self.jitter_gate)
+
+    # -- human-facing rendering -------------------------------------------
+    def to_scenario(self, knobs: dict):
+        """Render one knob vector as a Scenario (repro reports / ddmin
+        hand-off): enabled rows with their mutated times/targets, dup
+        clones as real rows. The network/priority scalars don't fit a
+        Scenario — carry them alongside (fuzz repros do)."""
+        from ..runtime.scenario import Scenario, _Row
+        sc = Scenario()
+        kn = {k: np.asarray(v) for k, v in knobs.items()}
+        for r in range(self.R):
+            on = bool(kn["row_on"][r]) or not self.drop_ok[r]
+            if not on:
+                continue
+            t = (int(kn["row_time"][r]) if self.time_ok[r]
+                 else int(self.base["time"][r]))
+            node = (int(kn["row_node"][r]) if self.node_ok[r]
+                    else int(self.base["node"][r]))
+            sc.rows.append(_Row(t, int(self.base["op"][r]), node,
+                                int(self.base["src"][r]),
+                                tuple(int(w) for w in
+                                      self.base["payload"][r])))
+        for d in range(self.D):
+            if not bool(kn["dup_on"][d]):
+                continue
+            srow = int(np.clip(kn["dup_src"][d], 0, self.R - 1))
+            if not self.drop_ok[srow]:
+                continue
+            node = (int(kn["row_node"][srow]) if self.node_ok[srow]
+                    else int(self.base["node"][srow]))
+            sc.rows.append(_Row(int(kn["dup_time"][d]),
+                                int(self.base["op"][srow]), node,
+                                int(self.base["src"][srow]),
+                                tuple(int(w) for w in
+                                      self.base["payload"][srow])))
+        sc.rows.sort(key=lambda r: r.time)
+        return sc
+
+
+# ---------------------------------------------------------------------------
+# jitted kernels — MODULE-LEVEL jits (the utils/hashing discipline): traces
+# are cached per shape, not per KnobPlan instance, so two campaigns over
+# equally-shaped scenarios share one executable.
+# ---------------------------------------------------------------------------
+
+
+def _take_rows(mat, idx):
+    """mat[idx] for mat[R, P] and idx[D] via one-hot matmul (gathers
+    serialize on TPU — ops/select.py rationale)."""
+    oh = (idx[:, None] == jnp.arange(mat.shape[0], dtype=jnp.int32))
+    return jnp.einsum("dr,rp->dp", oh.astype(mat.dtype), mat)
+
+
+def _mutate_one(kn, key, g, havoc):
+    R = kn["row_time"].shape[0]
+    D = kn["dup_src"].shape[0]
+    N = g["pool_ok"].shape[1] - 1
+    hist = jnp.zeros((N_MUT_OPS,), jnp.int32)
+    for k in prng.split(key, havoc):
+        ks = prng.split(k, 12)
+        op = prng.randint(ks[0], 0, N_MUT_OPS - 1)
+
+        # 0: time nudge — multi-scale delta on one mutable row
+        r_t, ok_t = sel.masked_choice(ks[1], g["time_ok"])
+        mag = prng.randint(ks[2], 6, 20)                   # 64us .. ~1s
+        raw = jax.random.randint(ks[3], (), 0,
+                                 (jnp.int32(1) << mag), dtype=jnp.int32)
+        delta = jnp.where(prng.bernoulli(ks[4], 0.5), raw + 1, -(raw + 1))
+        oh_t = sel.row_onehot(R, r_t) & (op == 0) & ok_t
+        row_time = jnp.clip(
+            kn["row_time"] + jnp.where(oh_t, delta, 0), 0, T.T_INF - 1)
+
+        # 1: target reshuffle — redraw inside the row's pool (or back to
+        # NODE_RANDOM when the draw falls outside it)
+        r_n, ok_n = sel.masked_choice(ks[5], g["node_ok"])
+        cand = prng.randint(ks[6], -1, N - 1)
+        allowed = sel.take1(sel.take_row(g["pool_ok"], r_n), cand + 1)
+        new_node = jnp.where(allowed, cand, jnp.asarray(T.NODE_RANDOM,
+                                                        jnp.int32))
+        oh_n = sel.row_onehot(R, r_n) & (op == 1) & ok_n
+        row_node = jnp.where(oh_n, new_node, kn["row_node"])
+
+        # 2: row toggle — drop (or revive) one droppable row
+        r_d, ok_d = sel.masked_choice(ks[7], g["drop_ok"])
+        row_on = kn["row_on"] ^ (sel.row_onehot(R, r_d) & (op == 2) & ok_d)
+
+        dup_src, dup_time, dup_on = kn["dup_src"], kn["dup_time"], kn["dup_on"]
+        dup_eff = jnp.asarray(False)
+        if D > 0:
+            # 3: row duplicate — toggle a dup slot; turning it on clones a
+            # droppable row at a nearby time
+            d_i = prng.randint(ks[8], 0, D - 1)
+            s_r, ok_s = sel.masked_choice(ks[9], g["drop_ok"])
+            dup_eff = ok_s
+            oh_d = sel.row_onehot(D, d_i) & (op == 3) & ok_s
+            turn_on = oh_d & ~kn["dup_on"]
+            near = prng.randint(ks[10], -200_000, 200_000)  # ±200ms
+            dup_on = kn["dup_on"] ^ oh_d
+            dup_src = jnp.where(turn_on, s_r, kn["dup_src"])
+            dup_time = jnp.where(
+                turn_on,
+                jnp.clip(sel.take1(row_time, s_r) + near, 0, T.T_INF - 1),
+                kn["dup_time"])
+
+        # 4: latency perturbation — shift the (lo, hi) pair (and the
+        # jitter bound on jitter-enabled builds)
+        is4 = op == 4
+        dlo = prng.randint(ks[2], -5_000, 5_000)
+        dhi = prng.randint(ks[3], -20_000, 20_000)
+        lat_lo = jnp.where(is4, jnp.clip(kn["lat_lo"] + dlo, 0, _LAT_CAP),
+                           kn["lat_lo"])
+        lat_hi = jnp.where(is4, jnp.clip(kn["lat_hi"] + dhi, 0, _LAT_CAP),
+                           kn["lat_hi"])
+        jitter = jnp.where(is4, jnp.clip(kn["jitter"] + dlo, 0, _JIT_CAP),
+                           kn["jitter"])
+
+        # 5: loss perturbation — drift, with an occasional reset to 0
+        drift = (prng.uniform(ks[4]) - 0.5) * 0.2
+        reset = prng.bernoulli(ks[7], 0.2)
+        # drift caps at 0.9 (beyond that lanes mostly stall to tlimit —
+        # wasted budget) but never pulls a hotter BASE loss down: the cap
+        # is max(0.9, parent), so bases in (0.9, 0.99] stay reachable
+        loss = jnp.where(op == 5,
+                         jnp.where(reset, jnp.float32(0.0),
+                                   jnp.clip(kn["loss"] + drift, 0.0,
+                                            jnp.maximum(jnp.float32(0.9),
+                                                        kn["loss"]))),
+                         kn["loss"])
+
+        # 6: priority perturbation — a fresh PCT tie-break policy
+        bits = jax.random.randint(ks[11], (), -(2**31) + 1, 2**31 - 1,
+                                  dtype=jnp.int32)
+        prio = jnp.where(op == 6, bits, kn["prio_nudge"])
+
+        kn = dict(row_time=row_time, row_node=row_node, row_on=row_on,
+                  dup_src=dup_src, dup_time=dup_time, dup_on=dup_on,
+                  loss=loss, lat_lo=lat_lo, lat_hi=lat_hi, jitter=jitter,
+                  prio_nudge=prio)
+        # count the op only when it actually wrote something: a draw whose
+        # guard found no mutable row (or no dup slot) is a no-op, and the
+        # histogram feeds fuzz()'s `mutation_ops` / --search-smoke's
+        # "operators used" gate
+        applied = (((op == 0) & ok_t) | ((op == 1) & ok_n)
+                   | ((op == 2) & ok_d) | ((op == 3) & dup_eff) | (op >= 4))
+        hist = hist + ((jnp.arange(N_MUT_OPS, dtype=jnp.int32) == op)
+                       & applied).astype(jnp.int32)
+    return kn, hist
+
+
+@functools.partial(jax.jit, static_argnames=("havoc",))
+def _mutate_batch(knobs, key, guards, havoc):
+    COMPILE_LOG.note_trace("mutate",
+                           batch=int(knobs["row_time"].shape[0]),
+                           havoc=havoc)
+    keys = jax.random.split(key, knobs["row_time"].shape[0])
+    out, hist = jax.vmap(_mutate_one, in_axes=(0, 0, None, None))(
+        knobs, keys, guards, havoc)
+    return out, hist.sum(0)
+
+
+@functools.partial(jax.jit, static_argnames=("n_init", "jitter_gate"))
+def _apply_batch(state, knobs, base, guards, n_init, jitter_gate):
+    COMPILE_LOG.note_trace("apply_knobs",
+                           batch=int(state.halted.shape[0]))
+    R = base["op"].shape[0]
+    N = guards["pool_ok"].shape[1] - 1
+
+    def one(s, kn):
+        D = kn["dup_src"].shape[0]
+        row_on = jnp.where(guards["drop_ok"], kn["row_on"], True)
+        row_time = jnp.where(guards["time_ok"],
+                             jnp.clip(kn["row_time"], 0, s.tlimit),
+                             base["time"])
+        row_node = jnp.where(guards["node_ok"],
+                             jnp.clip(kn["row_node"], -1, N - 1),
+                             base["node"])
+        # pool membership is enforced HERE, not trusted from the mutator:
+        # a hand-edited or corpus-loaded knob vector with an out-of-pool
+        # target falls back to NODE_RANDOM (the mutator's own fallback),
+        # so the chaos-recipe in-bounds contract holds for any input
+        oh_pool = ((row_node + 1)[:, None]
+                   == jnp.arange(N + 1, dtype=jnp.int32)[None, :])
+        in_pool = (guards["pool_ok"] & oh_pool).any(axis=1)
+        row_node = jnp.where(guards["node_ok"] & ~in_pool,
+                             jnp.asarray(T.NODE_RANDOM, jnp.int32), row_node)
+        seg_deadline = [jnp.where(row_on, row_time,
+                                  jnp.asarray(T.T_INF, jnp.int32))]
+        seg_kind = [jnp.where(row_on, T.EV_SUPER, T.EV_FREE)]
+        seg_node = [row_node]
+        seg_src = [base["src"]]
+        seg_tag = [base["op"]]
+        seg_payload = [base["payload"]]
+        if D > 0:
+            dsrc = jnp.clip(kn["dup_src"], 0, R - 1)
+            d_ok = kn["dup_on"] & sel.take1(guards["drop_ok"], dsrc)
+            seg_deadline.append(jnp.where(
+                d_ok, jnp.clip(kn["dup_time"], 0, s.tlimit),
+                jnp.asarray(T.T_INF, jnp.int32)))
+            seg_kind.append(jnp.where(d_ok, T.EV_SUPER, T.EV_FREE))
+            seg_node.append(sel.take1(row_node, dsrc))
+            seg_src.append(sel.take1(base["src"], dsrc))
+            seg_tag.append(sel.take1(base["op"], dsrc))
+            seg_payload.append(_take_rows(base["payload"], dsrc))
+        lo = n_init
+        hi = n_init + R + D
+
+        def put(col, segs):
+            v = jnp.concatenate(segs).astype(col.dtype)
+            return col.at[lo:hi].set(v)
+
+        lat_lo = jnp.clip(kn["lat_lo"], 0, _LAT_CAP)
+        return s.replace(
+            t_deadline=put(s.t_deadline, seg_deadline),
+            t_kind=put(s.t_kind, seg_kind),
+            t_node=put(s.t_node, seg_node),
+            t_src=put(s.t_src, seg_src),
+            t_tag=put(s.t_tag, seg_tag),
+            t_payload=put(s.t_payload, seg_payload),
+            loss=jnp.clip(kn["loss"], 0.0, 0.99),
+            lat_lo=lat_lo,
+            lat_hi=jnp.maximum(lat_lo, jnp.clip(kn["lat_hi"], 0, _LAT_CAP)),
+            jitter=(jnp.clip(kn["jitter"], 0, _JIT_CAP) if jitter_gate
+                    else s.jitter),
+            prio_nudge=kn["prio_nudge"])
+
+    return jax.vmap(one)(state, knobs)

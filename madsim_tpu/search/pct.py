@@ -1,0 +1,63 @@
+"""PCT-style priority perturbation over the event scheduler's tie-breaks.
+
+PCT (probabilistic concurrency testing) derandomizes schedule search: pick
+a small set of priority-change points and run the schedule those priorities
+induce, instead of sampling uniformly. The batched analog here: the
+scheduler's only free decision is the tie-break among earliest-deadline
+events (core/step.py), and `SimState.prio_nudge` replaces that uniform
+draw with a DETERMINISTIC priority order keyed on (nudge, slot identity).
+One nudge value = one tie-breaking policy; sweeping nudges enumerates
+policies the way PCT enumerates priority assignments — and because the
+nudge is a per-lane dynamic operand, a whole batch of policies runs as one
+dispatch with zero recompiles.
+
+Contract (tested in tests/test_search.py): `prio_nudge == 0` is
+bit-identical to the hook's absence — the uniform draw happens (and
+consumes its key) either way, and the nudged pick only replaces it under a
+`where` on the nudge. Nudged runs stay fully deterministic: same seed +
+same nudge = same trajectory, so (seed, nudge) is a complete repro handle.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import stats
+
+
+def with_prio_nudge(state, nudge):
+    """Set the per-lane PCT nudge on a (batched) state. `nudge` is a
+    scalar (applied to every lane) or an int32[B] array."""
+    nudge = jnp.asarray(nudge, jnp.int32)
+    return state.replace(
+        prio_nudge=jnp.broadcast_to(nudge, state.prio_nudge.shape))
+
+
+def pct_sweep(rt, seed: int, nudges, max_steps: int, chunk: int = 512,
+              fused: bool = True):
+    """Run ONE seed under many tie-break policies in one batch: lane i
+    replays `seed` with prio_nudge = nudges[i]. The distinct-schedule
+    count over the sweep measures how much of the seed's behavior was
+    tie-break luck vs forced by timing.
+
+    Returns a dict with per-lane u64 schedule hashes, the distinct count,
+    and {nudge: crash_code} for lanes that crashed (each is replayable
+    alone via the same (seed, nudge) pair)."""
+    nudges = np.asarray(nudges, np.int32).reshape(-1)
+    B = nudges.shape[0]
+    state = with_prio_nudge(
+        rt.init_batch(np.full(B, seed, np.uint32)), nudges)
+    if fused:
+        state = rt.run_fused(state, max_steps, chunk)
+    else:
+        state, _ = rt.run(state, max_steps, chunk)
+    hashes = stats.sched_hash_u64(state)
+    crashed = np.asarray(state.crashed)
+    codes = np.asarray(state.crash_code)
+    return dict(
+        hashes=hashes,
+        distinct_schedules=int(len(np.unique(hashes))),
+        crashed_by_nudge={int(nudges[i]): int(codes[i])
+                          for i in np.nonzero(crashed)[0]},
+    )

@@ -38,6 +38,10 @@ case "$tier" in
     # ring that exports as valid Chrome-trace JSON, and the exporter's
     # event counts must agree with the engine's own fired counts
     python bench.py --obs-smoke
+    # schedule-fuzzer smoke: a small coverage-guided campaign must beat
+    # blind explore() on the saturating workload, exercise the mutation
+    # operators, and enumerate PCT tie-break policies
+    python bench.py --search-smoke
     if [[ "${2:-}" == "--compile-smoke" ]]; then
       # shared step-program cache smoke: two structurally-equal configs
       # must cost exactly one retrace and stay bitwise-equal to a

@@ -1,0 +1,404 @@
+"""The coverage-guided schedule fuzzer (madsim_tpu/search, r9): PCT
+tie-break perturbation, on-device knob mutation bounds, corpus
+bookkeeping, the fuzz loop, and its compile discipline."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from madsim_tpu import (Corpus, KnobPlan, NetConfig, Runtime, Scenario,
+                        SimConfig, explore, fuzz, ms, pct_sweep, sec,
+                        with_prio_nudge)
+from madsim_tpu.core import types as T
+from madsim_tpu.models.pingpong import PingPong, state_spec
+
+
+def _saturating_rt(target=6):
+    """Fixed-latency chaos: seeds alone exhaust the schedule space fast —
+    the regime where search beats sampling. ONE definition, shared with
+    bench --mode search_ab and examples/fuzz_search.py."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _make_saturating_runtime
+    return _make_saturating_runtime(target=target)
+
+
+def _chaos_raft(n_cmds=4):
+    from madsim_tpu.models.raft import make_raft_runtime
+    from madsim_tpu.runtime import chaos
+    cfg = SimConfig(n_nodes=5, event_capacity=128, time_limit=sec(6),
+                    net=NetConfig(packet_loss_rate=0.05))
+    sc = chaos.madraft_churn(servers=range(5), rounds=3)
+    return make_raft_runtime(5, log_capacity=8, n_cmds=n_cmds,
+                             scenario=sc, cfg=cfg)
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestPct:
+    def test_zero_nudge_bit_identical(self):
+        # the prio_nudge==0 contract: explicitly setting the nudge to 0
+        # changes NOTHING — same trajectories, every leaf, both runners.
+        # (Pre-PR equivalence rides on this plus the untouched golden
+        # model tests: at nudge 0 the hook's pick is discarded by the
+        # `where` and the PRNG stream never shifts.)
+        rt = _chaos_raft()
+        seeds = np.arange(24)
+        plain, _ = rt.run(rt.init_batch(seeds), 800, 256)
+        zeroed, _ = rt.run(with_prio_nudge(rt.init_batch(seeds), 0),
+                           800, 256)
+        _leaves_equal(plain, zeroed)
+        fused = rt.run_fused(with_prio_nudge(rt.init_batch(seeds), 0),
+                             800, 256)
+        _leaves_equal(plain, fused)
+
+    def test_nonzero_nudge_changes_schedules_deterministically(self):
+        rt = _chaos_raft()
+        seeds = np.arange(16)
+
+        def run(nudge):
+            s = with_prio_nudge(rt.init_batch(seeds), nudge)
+            return rt.run_fused(s, 800, 256)
+
+        base = run(0)
+        nudged = run(np.arange(1, 17, dtype=np.int32))
+        # the lever moves: most lanes take a different dispatch order
+        h0 = np.asarray(base.sched_hash)
+        h1 = np.asarray(nudged.sched_hash)
+        assert (h0 != h1).any(axis=-1).sum() > 8
+        # and deterministically: same (seed, nudge) = same trajectory
+        again = run(np.arange(1, 17, dtype=np.int32))
+        _leaves_equal(nudged, again)
+
+    def test_pct_sweep_enumerates_policies(self):
+        res = pct_sweep(_saturating_rt(), seed=3, nudges=np.arange(24),
+                        max_steps=1000, chunk=256)
+        assert res["distinct_schedules"] > 1
+        # nudge 0 is in the sweep and equals the plain run of that seed
+        rt = _saturating_rt()
+        plain = rt.run_fused(rt.init_single(3), 1000, 256)
+        from madsim_tpu.parallel.stats import sched_hash_u64
+        assert res["hashes"][0] == sched_hash_u64(plain)[0]
+
+
+class TestMutateApply:
+    def _mutated_state(self, rt, batch=24, rounds=4, havoc=6):
+        plan = KnobPlan.from_runtime(rt, dup_slots=2)
+        knobs = {k: jnp.asarray(v) for k, v in
+                 plan.base_batch(batch).items()}
+        key = jax.random.PRNGKey(7)
+        for i in range(rounds):     # stack mutations to push extremes
+            knobs, _ = plan.mutate(knobs, jax.random.fold_in(key, i),
+                                   havoc=havoc)
+        state = plan.apply(rt.init_batch(np.arange(batch)), knobs)
+        return plan, knobs, state
+
+    def test_heavily_mutated_knobs_stay_in_bounds(self):
+        # chaos-recipe composition with pool-restricted NODE_RANDOM rows:
+        # whatever the mutator does, what lands in the event table must
+        # honor every bound the engine (and the recipe's among= pools)
+        # relies on
+        from madsim_tpu.models.raft import make_raft_runtime
+        from madsim_tpu.runtime import chaos
+        cfg = SimConfig(n_nodes=5, event_capacity=128, time_limit=sec(6))
+        sc = chaos.rolling_kills(rounds=3, among=[0, 1, 2])
+        sc = chaos.split_brain(at=sec(2), group=[0, 1],
+                               heal_after=sec(1), sc=sc)
+        rt = make_raft_runtime(5, log_capacity=8, n_cmds=4,
+                               scenario=sc, cfg=cfg)
+        plan, knobs, state = self._mutated_state(rt)
+        n0, R, D, N = plan.n_init, plan.R, plan.D, plan.N
+        dl = np.asarray(state.t_deadline)[:, n0:n0 + R + D]
+        kind = np.asarray(state.t_kind)[:, n0:n0 + R + D]
+        node = np.asarray(state.t_node)[:, n0:n0 + R + D]
+        tlim = int(cfg.time_limit)
+        assert (((dl >= 0) & (dl <= tlim)) | (dl == T.T_INF)).all()
+        assert np.isin(kind, [T.EV_FREE, T.EV_SUPER]).all()
+        assert ((node >= -1) & (node < N)).all()
+        # pool-restricted rows: mutated targets stay inside the recipe's
+        # among= pool (or NODE_RANDOM)
+        for r in range(R):
+            if plan.node_ok[r] and plan.base["payload"][r].any():
+                tgt = node[:, r]
+                assert plan.pool_ok[r][tgt + 1].all(), (r, np.unique(tgt))
+        # the HALT row is pinned: exactly at the time limit, still armed
+        halt_rows = np.nonzero(plan.base["op"] == T.OP_HALT)[0]
+        assert halt_rows.size == 1
+        assert (dl[:, halt_rows[0]] == tlim).all()
+        assert (kind[:, halt_rows[0]] == T.EV_SUPER).all()
+        # scalar knobs in bounds
+        loss = np.asarray(state.loss)
+        lo, hi = np.asarray(state.lat_lo), np.asarray(state.lat_hi)
+        assert ((loss >= 0) & (loss <= 0.99)).all()
+        assert ((lo >= 0) & (lo <= hi)).all()
+        # jitterless build: the jitter bound must not have moved
+        assert (np.asarray(state.jitter) == 0).all()
+
+    @pytest.mark.parametrize(
+        "make", ["raft",
+                 pytest.param("wal_kv", marks=pytest.mark.slow)])
+    def test_mutated_scenarios_run_vs_fused_bit_identical(self, make):
+        # per-lane mutated scenarios (incl. NODE_RANDOM chaos) through the
+        # chunked and the fused runner: bitwise-equal final state — the
+        # fuzzer may trust either runner for any mutant batch
+        if make == "raft":
+            rt = _chaos_raft()
+            steps = 1000
+        else:
+            from madsim_tpu.models.wal_kv import make_wal_kv_runtime
+            sc = Scenario()
+            for t in range(3):
+                sc.at(ms(150) + ms(250) * t).kill_random(among=[0])
+                sc.at(ms(210) + ms(250) * t).restart_random(among=[0])
+            rt = make_wal_kv_runtime(n_clients=2, n_ops=8, wal_cap=64,
+                                     sync_wal=False, scenario=sc)
+            steps = 20_000
+        plan = KnobPlan.from_runtime(rt, dup_slots=2)
+        knobs, _ = plan.mutate(plan.base_batch(24), jax.random.PRNGKey(3),
+                               havoc=4)
+        chunked, _ = rt.run(
+            plan.apply(rt.init_batch(np.arange(24)), knobs), steps, 256)
+        fused = rt.run_fused(
+            plan.apply(rt.init_batch(np.arange(24)), knobs), steps, 256)
+        _leaves_equal(chunked, fused)
+        # in-bounds under execution too: no capacity/time overflow oops
+        assert (np.asarray(chunked.oops) == 0).all()
+
+    def test_dup_slots_capacity_bounded(self):
+        # a scenario that nearly fills the table gets fewer (or zero) dup
+        # slots instead of a template overflow
+        cfg = SimConfig(n_nodes=2, event_capacity=8, time_limit=sec(1))
+        sc = Scenario()
+        for t in range(4):
+            sc.at(ms(t + 1)).kill(0)
+        rt = Runtime(cfg, [PingPong(2, target=1)], state_spec(),
+                     scenario=sc)
+        plan = KnobPlan.from_runtime(rt, dup_slots=8)
+        assert plan.D == cfg.event_capacity - 2 - plan.R
+        assert plan.D >= 0
+
+    def test_apply_enforces_pool_on_foreign_knobs(self):
+        # apply is the safety boundary (DESIGN §11), not just the mutator:
+        # a knob vector that never went through mutate() — hand-edited,
+        # corpus-loaded, or from a saved repro — with an out-of-pool
+        # target must snap to NODE_RANDOM, while in-pool and non-node
+        # rows pass through bit-exactly
+        cfg = SimConfig(n_nodes=4, time_limit=sec(2))
+        sc = Scenario()
+        sc.at(ms(100)).kill_random(among=[0, 1])
+        rt = Runtime(cfg, [PingPong(4, target=2)], state_spec(),
+                     scenario=sc)
+        plan = KnobPlan.from_runtime(rt, dup_slots=0)
+        r = int(np.argmax(plan.node_ok))
+        kn = plan.base_knobs()
+        kn["row_node"][r] = 3                      # outside among=[0, 1]
+        st = plan.apply(rt.init_batch(np.asarray([1], np.uint32)),
+                        plan.stack([kn]))
+        assert int(np.asarray(st.t_node)[0, plan.n_init + r]) \
+            == T.NODE_RANDOM
+        kn["row_node"][r] = 1                      # inside the pool
+        st = plan.apply(rt.init_batch(np.asarray([1], np.uint32)),
+                        plan.stack([kn]))
+        assert int(np.asarray(st.t_node)[0, plan.n_init + r]) == 1
+
+
+class TestCorpus:
+    def _plan(self):
+        return KnobPlan.from_runtime(_saturating_rt(), dup_slots=1)
+
+    def test_dedupe_by_schedule_hash(self):
+        plan = self._plan()
+        c = Corpus(plan, rng=np.random.default_rng(0))
+        kb = plan.base_batch(4)
+        stats = c.observe(kb, seeds=np.arange(4),
+                          hashes_u64=np.asarray([1, 2, 2, 3]),
+                          crashed=np.asarray([False, True, False, False]),
+                          codes=np.asarray([0, 9, 0, 0]),
+                          parent_ids=np.full(4, -1), round_no=0)
+        assert stats["new"] == 3 and len(c) == 3
+        assert stats["new_crash_codes"] == [9]
+        # re-observing the same hashes admits nothing
+        stats = c.observe(kb, np.arange(4), np.asarray([1, 2, 2, 3]),
+                          np.zeros(4, bool), np.zeros(4, int),
+                          np.full(4, -1), 1)
+        assert stats["new"] == 0 and len(c) == 3
+        # the crashed lane entered hot
+        crash_entry = [e for e in c.entries if e["hash"] == 2][0]
+        assert crash_entry["energy"] > [e for e in c.entries
+                                        if e["hash"] == 1][0]["energy"]
+
+    def test_energy_weighted_scheduling_with_fresh_floor(self):
+        plan = self._plan()
+        c = Corpus(plan, rng=np.random.default_rng(1), fresh_frac=0.25)
+        kb = plan.base_batch(3)
+        c.observe(kb, np.arange(3), np.asarray([10, 11, 12]),
+                  np.zeros(3, bool), np.zeros(3, int), np.full(3, -1), 0)
+        c.entries[1]["energy"] = 50.0          # make one entry hot
+        _, ids = c.schedule(400)
+        fresh = (ids == -1).sum()
+        assert 40 <= fresh <= 180              # ~25% exploration floor
+        picked = ids[ids >= 0]
+        # the hot entry dominates the mutation budget
+        assert (picked == 1).sum() > 0.7 * picked.size
+
+    def test_parent_reward(self):
+        plan = self._plan()
+        c = Corpus(plan, rng=np.random.default_rng(2))
+        kb = plan.base_batch(1)
+        c.observe(kb, [0], np.asarray([1]), np.zeros(1, bool),
+                  np.zeros(1, int), np.full(1, -1), 0)
+        e0 = c.entries[0]["energy"]
+        # a child of entry 0 discovers a new schedule -> parent rewarded
+        c.observe(kb, [1], np.asarray([2]), np.zeros(1, bool),
+                  np.zeros(1, int), np.asarray([0]), 1)
+        assert c.entries[0]["energy"] > e0 * 1.2
+
+
+class TestFuzz:
+    def test_beats_blind_explore_on_saturating_space(self):
+        # the subsystem's reason to exist: where seed sampling goes dry,
+        # knob search keeps finding interleavings — strictly more distinct
+        # schedules at the same rounds x batch x steps budget
+        kw = dict(max_steps=1000, batch=48, max_rounds=3, dry_rounds=4,
+                  chunk=256)
+        blind = explore(_saturating_rt(), **kw)
+        res = fuzz(_saturating_rt(), **kw)
+        assert res["distinct_schedules"] > blind["distinct_schedules"]
+        assert res["corpus_size"] >= blind["distinct_schedules"]
+        assert sum(res["mutation_ops"].values()) > 0
+
+    def test_dry_stop_and_campaign_determinism(self):
+        kw = dict(max_steps=600, batch=32, max_rounds=8, dry_rounds=2,
+                  chunk=128, rng_seed=11)
+
+        def tiny():
+            # the test_explore saturating workload: two nodes, constant
+            # latency, NO chaos — a handful of dispatch orders exist
+            cfg = SimConfig(n_nodes=2, time_limit=sec(5),
+                            net=NetConfig(send_latency_min=ms(1),
+                                          send_latency_max=ms(1)))
+            return Runtime(cfg, [PingPong(2, target=3)], state_spec())
+
+        # havoc=0 (no mutation) reduces the fuzzer to blind sampling: on
+        # a trivially tiny space the dry-round stop must fire. (With
+        # mutation ON even small spaces keep yielding new interleavings —
+        # that resistance to drying IS the subsystem, and is what
+        # test_beats_blind_explore_on_saturating_space measures.)
+        r1 = fuzz(tiny(), havoc=0, **kw)
+        assert r1["saturated"] and r1["rounds"] < 8
+        assert sum(r1["mutation_ops"].values()) == 0
+        # and a campaign is replayable: same rng_seed = same coverage
+        r2 = fuzz(_saturating_rt(target=2), **kw)
+        r3 = fuzz(_saturating_rt(target=2), **kw)
+        assert r2["new_per_round"] == r3["new_per_round"]
+        assert r2["distinct_schedules"] == r3["distinct_schedules"]
+
+    @pytest.mark.slow
+    def test_crash_harvest_and_repro_replays(self):
+        # the wal_kv known-red workload: the campaign harvests the crash
+        # with a FULL (seed, knobs) repro that replays single-lane
+        from madsim_tpu.models import wal_kv
+        from madsim_tpu.models.wal_kv import make_wal_kv_runtime
+
+        sc = Scenario()
+        for t in range(6):
+            sc.at(ms(150) + ms(250) * t).kill(0)
+            sc.at(ms(210) + ms(250) * t).restart(0)
+        rt = make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                                 sync_wal=False, scenario=sc)
+        res = fuzz(rt, max_steps=60_000, batch=16, max_rounds=2,
+                   dry_rounds=3, chunk=512)
+        assert res["crashes"] > 0
+        assert wal_kv.CRASH_LOST_WRITE in res["crash_repros"]
+        rep = res["crash_repros"][wal_kv.CRASH_LOST_WRITE]
+        assert "kill node 0" in rep["script"]
+        plan = KnobPlan.from_runtime(rt, dup_slots=2)
+        state = plan.apply(
+            rt.init_batch(np.asarray([rep["seed"]], np.uint32)),
+            plan.stack([rep["knobs"]]))
+        state, _ = rt.run(state, 60_000, 512)
+        assert bool(np.asarray(state.crashed)[0])
+        assert int(np.asarray(state.crash_code)[0]) \
+            == wal_kv.CRASH_LOST_WRITE
+
+    def test_observer_sees_fuzz_rounds(self):
+        from madsim_tpu.obs import SweepObserver
+
+        class Rec(SweepObserver):
+            def __init__(self):
+                self.rounds, self.done = [], []
+
+            def on_round(self, rec):
+                self.rounds.append(rec)
+
+            def on_done(self, rec):
+                self.done.append(rec)
+
+        obs = Rec()
+        fuzz(_saturating_rt(), max_steps=600, batch=16, max_rounds=2,
+             dry_rounds=3, chunk=128, observer=obs)
+        assert len(obs.rounds) == 2
+        assert obs.rounds[0]["kind"] == "fuzz_round"
+        assert "corpus_size" in obs.rounds[0]
+        assert obs.done and obs.done[0]["kind"] == "done"
+
+
+class TestCompileDiscipline:
+    def test_warm_campaign_never_recompiles(self):
+        # satellite: a full fuzz campaign (>= 3 mutation rounds, mixed
+        # operators) on warm caches must trigger exactly the warm-cache
+        # number of traces — ZERO. Mutation is pure operand traffic.
+        from madsim_tpu.compile.cache import COMPILE_LOG
+        kw = dict(max_steps=800, batch=32, max_rounds=4, dry_rounds=5,
+                  chunk=256)
+        fuzz(_saturating_rt(), **kw)             # warm: mutate/apply/fused
+        before = COMPILE_LOG.snapshot()["traces_total"]
+        res = fuzz(_saturating_rt(), **kw)       # a fresh Runtime + plan
+        after = COMPILE_LOG.snapshot()["traces_total"]
+        assert after == before, COMPILE_LOG.recent(8)
+        assert res["rounds"] == 4                # >= 3 mutation rounds
+        assert len([v for v in res["mutation_ops"].values() if v]) >= 3
+
+
+@pytest.mark.slow
+class TestFlagshipAcceptance:
+    def test_fuzzer_vs_blind_flagship_raft_chaos(self):
+        # flagship Raft chaos at B=512, equal device-dispatch budget.
+        # Randomized election timeouts put every seed on a distinct
+        # schedule, so blind explore() sits at the per-lane ceiling here;
+        # the fuzzer must MATCH that ceiling (its mutants may not collapse
+        # coverage) while it strictly dominates where blind saturates
+        # (test_beats_blind_explore_on_saturating_space and
+        # bench --mode search_ab measure that regime).
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from bench import _make_runtime
+        kw = dict(max_steps=768, batch=512, max_rounds=2, dry_rounds=3,
+                  chunk=256)
+        blind = explore(_make_runtime(), **kw)
+        res = fuzz(_make_runtime(), **kw)
+        assert res["distinct_schedules"] >= blind["distinct_schedules"]
+        assert res["distinct_schedules"] == res["seeds_run"]  # ceiling
+
+    def test_zero_nudge_equivalence_shard_kv(self):
+        # the third flagship of the equivalence matrix (raft and wal_kv
+        # run in the fast lane, TestPct/TestMutateApply)
+        from madsim_tpu.models.shard_kv import make_shard_runtime
+        rt = make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2,
+                                n_ops=8, max_cfg=4, log_capacity=64)
+        seeds = np.arange(16)
+        plain, _ = rt.run(rt.init_batch(seeds), 4000, 512)
+        zeroed = rt.run_fused(with_prio_nudge(rt.init_batch(seeds), 0),
+                              4000, 512)
+        _leaves_equal(plain, zeroed)
