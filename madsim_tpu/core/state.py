@@ -26,15 +26,22 @@ from flax import struct
 
 from . import types as T
 
-# SimState fields owned by the flight recorder (cfg.trace_cap). One
-# schema constant so every consumer follows it automatically: excluded
-# from fingerprints (utils/hashing — observation only, never a replay
-# domain), read by obs/rings.py, compared explicitly in the
-# fused-vs-chunked equivalence tests and bench.py --obs-smoke.
-# trace_cap is the DYNAMIC capacity operand (columns are sized to the
-# power-of-two bucket, cfg.trace_cap_bucket — DESIGN §10).
+# SimState fields owned by the flight recorder (cfg.trace_cap), the
+# causal-lineage layer (r10 — rides the same gate), and the
+# prefix-coverage sketch (cfg.sketch_slots). One schema constant so
+# every consumer follows it automatically: excluded from fingerprints
+# (utils/hashing — observation only, never a replay domain), read by
+# obs/rings.py (the tr_* columns), compared explicitly in the
+# fused-vs-chunked equivalence tests and bench.py --obs-smoke /
+# --causal-smoke. trace_cap is the DYNAMIC capacity operand (columns
+# are sized to the power-of-two bucket, cfg.trace_cap_bucket — DESIGN
+# §10); sketch_every is the DYNAMIC fold period for the structurally
+# sized cov_sketch column (DESIGN §12).
 TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
-                "tr_kind", "tr_node", "tr_src", "tr_tag")
+                "tr_kind", "tr_node", "tr_src", "tr_tag",
+                "tr_parent", "tr_lamport",
+                "ev_prov", "lamport",
+                "cov_sketch", "sketch_every")
 
 
 @struct.dataclass
@@ -74,6 +81,26 @@ class SimState:
     t_src: jax.Array        # int32[C] — source node (msgs) / link src (super)
     t_tag: jax.Array        # int32[C] — msg tag / timer tag / super opcode
     t_payload: jax.Array    # int32[C, P]
+
+    # --- causal lineage (r10; compiled in iff cfg.trace_cap > 0) ----------
+    # A provenance matrix for the pending rows above, plus one Lamport
+    # clock per node — together they let the ring carry (parent_dispatch,
+    # lamport) for every dispatched event, so a crash explains itself by
+    # walking parent edges backward (obs/causal.py) even after the ring
+    # wrapped. Observation only: no randomness consumed, excluded from
+    # fingerprints, zero-size when the recorder is compiled out. One
+    # [C, 2] matrix, not two [C] columns: the step then pays ONE extra
+    # emission write and ONE extra dispatch gather (the t_payload shape,
+    # half the lineage cost measured by bench.py --mode causal_ab).
+    ev_prov: jax.Array      # int32[C, 2] — per pending row:
+                            # [0] dispatch index of the step that
+                            #     enqueued it; -1 = external (scenario
+                            #     row, node boot, host-injected op)
+                            # [1] the Lamport timestamp it carries
+                            #     (sender's clock at enqueue — the
+                            #     "message timestamp" of the Lamport rule)
+    lamport: jax.Array      # int32[N] — per-node Lamport clock:
+                            # max(own, carried) + 1 at every dispatch
 
     # --- nodes ------------------------------------------------------------
     alive: jax.Array        # bool[N]
@@ -135,6 +162,23 @@ class SimState:
     tr_node: jax.Array      # int32[bucket]
     tr_src: jax.Array       # int32[bucket]
     tr_tag: jax.Array       # int32[bucket]
+    tr_parent: jax.Array    # int32[bucket] — the dispatched event's
+                            # ev_parent (the happens-before edge; -1 =
+                            # external) — recorded per event, so the
+                            # causal chain survives ring wrap up to the
+                            # oldest surviving record
+    tr_lamport: jax.Array   # int32[bucket] — the acting node's Lamport
+                            # clock AFTER this dispatch
+
+    # --- prefix-coverage sketch (cfg.sketch_slots; obs/causal.py) ---------
+    # Slot j holds the running sched_hash (lanes XOR-folded) after this
+    # lane's (j+1)*sketch_every-th dispatch: two lanes' sketches first
+    # differ at the slot whose schedule prefix first diverged — the
+    # per-lane divergence depth parallel/stats.divergence_profile and
+    # the corpus's early-divergence energy bonus read, with zero host
+    # round-trips during the run. 0 means "checkpoint not reached".
+    cov_sketch: jax.Array   # uint32[sketch_slots]
+    sketch_every: jax.Array  # int32 — DYNAMIC fold period (cfg.sketch_every)
 
     # --- extension state (plugin framework analog, plugin.rs) -------------
     ext: Any                # dict: extension name -> its state subtree
@@ -169,6 +213,11 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         t_src=jnp.zeros((C,), ti),
         t_tag=jnp.zeros((C,), i32),
         t_payload=jnp.zeros((C, P), i32),
+        # lineage rides the recorder gate (zero-size when compiled out);
+        # template/scenario rows are external: parent -1, carried clock 0
+        ev_prov=jnp.tile(jnp.asarray([[-1, 0]], i32),
+                         (C if cfg.trace_cap > 0 else 0, 1)),
+        lamport=jnp.zeros((N if cfg.trace_cap > 0 else 0,), i32),
         alive=jnp.zeros((N,), bool),
         paused=jnp.zeros((N,), bool),
         node_state=node_state,
@@ -195,6 +244,10 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         tr_node=jnp.zeros((cfg.trace_cap_bucket,), i32),
         tr_src=jnp.zeros((cfg.trace_cap_bucket,), i32),
         tr_tag=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        tr_parent=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        tr_lamport=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        cov_sketch=jnp.zeros((cfg.sketch_slots,), jnp.uint32),
+        sketch_every=jnp.asarray(cfg.sketch_every, i32),
         ext=ext_state if ext_state is not None else {},
     )
 

@@ -161,6 +161,22 @@ def make_step(
         ev_tag = sel.take1(s.t_tag, idx)
         ev_payload = sel.take_row(s.t_payload, idx)
 
+        # ---- causal lineage (cfg.trace_cap gate; obs/causal.py) ----------
+        # The dispatched row's provenance: which dispatch enqueued it
+        # (-1 = external) and the Lamport timestamp it carried. The
+        # Lamport-rule clock advance happens below, after _apply_super
+        # resolves NODE_RANDOM targets. Pure selects over the lineage
+        # columns: no randomness consumed, no non-lineage state touched,
+        # so trajectories are bit-identical with the recorder compiled
+        # out (the r7 ring discipline).
+        if cfg.trace_cap > 0:
+            disp_idx = s.steps              # this dispatch's index (the
+            # value tr_step records for it: steps increments by `valid`
+            # below, so the ring's `s.steps - 1` equals this)
+            prov = sel.take_row(s.ev_prov, idx)          # [parent, carried]
+            ev_parent = jnp.where(valid, prov[0], jnp.asarray(-1,
+                                                             jnp.int32))
+
         # schedule-coverage hash: fold the dispatched event's identity into
         # a running FNV-style mix. Pure VPU arithmetic, consumes no
         # randomness, so it cannot perturb replay; distinct interleavings
@@ -217,6 +233,18 @@ def make_step(
                 sub = e.reset_node(cfg, sub, reset_target, reset_mask)
                 new_ext[e.name] = sub
             s = s.replace(ext=new_ext)
+
+        # Lamport rule at the node the dispatch actually ACTED on:
+        # clock = max(own, carried) + 1. For supervisor ops the scheduled
+        # row may say NODE_RANDOM (ev_node clips it to 0), but a
+        # kill/restart is an event AT the node _apply_super resolved —
+        # so the clock advances there, not at the clipped placeholder.
+        if cfg.trace_cap > 0:
+            lam_node = jnp.where(is_super, reset_target, ev_node)
+            ev_lamport = jnp.maximum(sel.take1(s.lamport, lam_node),
+                                     prov[1]) + 1
+            s = s.replace(lamport=sel.put_row(s.lamport, lam_node,
+                                              ev_lamport, valid))
 
         # ---- 3. protocol handler dispatch ---------------------------------
         node_ok = (sel.take1(s.alive, ev_node)
@@ -403,6 +431,23 @@ def make_step(
                 t_tag=put(s.t_tag, em_tag),
                 t_payload=put(s.t_payload, em_payload),
             )
+            if cfg.trace_cap > 0:
+                # provenance of every emitted row: enqueued by THIS
+                # dispatch, carrying the acting node's post-dispatch
+                # clock (the Lamport message timestamp). Every emission
+                # of a dispatch writes the SAME pair, so each lowering
+                # reuses its own machinery — the scatter path's
+                # drop-mode slots_eff, the one-hot path's existing [C]
+                # `written` mask (never rebuilt; --mode causal_ab
+                # bounds the whole lineage build's cost)
+                prov_new = jnp.stack([disp_idx, ev_lamport])
+                if em_scatter:
+                    s = s.replace(ev_prov=s.ev_prov.at[slots_eff].set(
+                        jnp.broadcast_to(prov_new, (E, 2)),
+                        mode="drop", unique_indices=True))
+                else:
+                    s = s.replace(ev_prov=jnp.where(
+                        written[:, None], prov_new[None, :], s.ev_prov))
 
         # oops/steps are correctness-bearing and always tracked; the stat
         # counters honor cfg.collect_stats (Stat is optional in the
@@ -421,6 +466,25 @@ def make_step(
                         T.OOPS_TIME_OVERFLOW, 0),
             steps=s.steps + valid.astype(jnp.int32),
         )
+
+        # ---- prefix-coverage sketch (cfg.sketch_slots; DESIGN §12) -------
+        # Fold the running sched_hash into slot j = steps/every - 1 at
+        # every sketch_every-th dispatch: slot j then witnesses the whole
+        # (j+1)*every-step prefix, so the first slot where two lanes'
+        # sketches differ bounds their first schedule divergence — depth
+        # telemetry that never leaves the device mid-run. One [slots]
+        # one-hot select per step; `every` is a dynamic operand
+        # (s.sketch_every), only the slot COUNT shapes the program.
+        if cfg.sketch_slots > 0:
+            period = jnp.maximum(s.sketch_every, 1)
+            ck = s.steps // period
+            at_ck = (valid & (s.steps == ck * period) & (ck >= 1)
+                     & (ck <= cfg.sketch_slots))
+            oh_ck = sel.row_onehot(
+                cfg.sketch_slots,
+                jnp.clip(ck - 1, 0, cfg.sketch_slots - 1)) & at_ck
+            s = s.replace(cov_sketch=jnp.where(
+                oh_ck, s.sched_hash[0] ^ s.sched_hash[1], s.cov_sketch))
 
         # ---- 5. end conditions -------------------------------------------
         # deadlock: nothing can ever run again (madsim task.rs:116 panic)
@@ -492,6 +556,11 @@ def make_step(
                 tr_node=ringput(s.tr_node, record["node"]),
                 tr_src=ringput(s.tr_src, record["src"]),
                 tr_tag=ringput(s.tr_tag, record["tag"]),
+                # the lineage pair: each recorded event carries its
+                # happens-before parent and post-dispatch Lamport clock,
+                # so causal chains survive ring wrap (obs/causal.py)
+                tr_parent=ringput(s.tr_parent, ev_parent),
+                tr_lamport=ringput(s.tr_lamport, ev_lamport),
                 trace_pos=s.trace_pos + rec_w.astype(jnp.int32),
             )
         if extensions:

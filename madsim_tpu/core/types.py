@@ -243,6 +243,20 @@ class SimConfig:
     # domain (the config hash does cover it, since the compiled program
     # differs).
     trace_cap: int = 0
+    # prefix-coverage sketch (obs/causal.py, parallel/stats.py): number
+    # of on-device checkpoint slots per lane. 0 (default) compiles the
+    # sketch out (zero-size column, no fold code in the step). > 0 folds
+    # the running `sched_hash` into slot j after the lane's
+    # (j+1)*sketch_every-th dispatch, so two lanes' sketches first
+    # differ at the slot whose prefix first diverged — a per-lane
+    # divergence DEPTH (not just a terminal distinct/same bit) that
+    # never leaves the device mid-run. Like trace_cap, an observation
+    # lever: the fold consumes no randomness and touches no non-sketch
+    # state, so trajectories are BIT-IDENTICAL across settings.
+    # sketch_slots is STRUCTURAL (it shapes the column); sketch_every is
+    # DYNAMIC (SimState.sketch_every — retune without recompile).
+    sketch_slots: int = 0
+    sketch_every: int = 64
     # emission-write lowering: how staged emissions land in the event
     # table. "onehot" = [E, C] one-hot masked-sum (VPU-friendly — the TPU
     # default); "scatter" = one XLA scatter per column at distinct slot
@@ -259,6 +273,8 @@ class SimConfig:
         assert self.event_capacity >= 4
         assert self.payload_words >= 1
         assert self.trace_cap >= 0
+        assert self.sketch_slots >= 0
+        assert self.sketch_every >= 1
         assert self.table_dtype in ("int32", "int16")
         assert self.emission_write in ("auto", "onehot", "scatter")
         if self.table_dtype == "int16":
@@ -282,10 +298,10 @@ class SimConfig:
         ride as operands. `emission_write` stays raw here — 'auto'
         resolves per backend at trace time, and the cache keys the
         backend separately."""
-        return ("simconfig-v1", self.n_nodes, self.event_capacity,
+        return ("simconfig-v2", self.n_nodes, self.event_capacity,
                 self.payload_words, self.table_dtype, self.emission_write,
                 bool(self.collect_stats), self.trace_cap_bucket,
-                self.net.op_jitter_max > 0)
+                self.sketch_slots, self.net.op_jitter_max > 0)
 
     def hash(self) -> str:
         """Stable 8-hex-digit config hash, printed on test failure so a repro

@@ -18,8 +18,15 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   boundaries run()/run_compacting()/explore() already pay
                   for; JsonlObserver writes the records as JSONL.
   * progress.py — ProgressObserver: live one-line sweep progress on a TTY.
+  * causal.py   — (r10) the WHY layer over the ring: happens-before edges
+                  from the per-event lineage pair (parent dispatch +
+                  Lamport clock), `explain_crash` walks them backward
+                  from a crash to its minimal causal chain, and
+                  `sketch_divergence` reads where two lanes' schedules
+                  first split from the on-device prefix sketches.
 """
 
+from .causal import explain_crash, happens_before, sketch_divergence
 from .metrics import JsonlObserver, SweepObserver, TeeObserver
 from .progress import ProgressObserver
 from .rings import ring_records, sampled_lanes
@@ -29,4 +36,5 @@ __all__ = [
     "SweepObserver", "JsonlObserver", "TeeObserver", "ProgressObserver",
     "ring_records", "sampled_lanes", "to_chrome_events",
     "export_chrome_trace",
+    "explain_crash", "happens_before", "sketch_divergence",
 ]

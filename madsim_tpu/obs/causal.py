@@ -1,0 +1,134 @@
+"""Causal lineage: happens-before edges, crash explanation, divergence depth.
+
+The flight recorder (r7) answers *what* a lane dispatched; the lineage
+layer (r10) answers *why*: every recorded event carries `parent` — the
+dispatch index of the step that ENQUEUED it (-1 = external: a scenario
+row, a node boot, a host-injected op) — and `lamport`, the acting node's
+Lamport clock after the dispatch (clock = max(own, carried) + 1, the
+classic rule; the carried timestamp rides in the event table's
+`ev_prov` provenance matrix). Parent edges form the happens-before DAG of the
+trajectory; walking them backward from a crash yields the minimal causal
+chain that produced it — the batched analog of reading a madsim replay
+log backwards from the panic.
+
+Wrap/overflow contract (DESIGN §12): `parent` is a DISPATCH INDEX, not a
+ring slot — it stays meaningful after the ring wraps. Every valid
+dispatch of a sampled lane is recorded, so a parent index either still
+sits in the ring (the edge resolves) or was overwritten by wrap (the
+chain reports `truncated=True` and stops there). A chain can therefore
+always be trusted as far as it goes; it just may not reach t=0.
+
+Everything here is host-side numpy over a `ring_records()` read — one
+O(trace_cap) transfer after the sweep, nothing during it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .rings import ring_records
+
+# record fields copied into chain/edge dicts (lineage pair included)
+_FIELDS = ("step", "now", "kind", "node", "src", "tag", "parent", "lamport")
+
+
+def _rec_at(recs: dict, i: int) -> dict:
+    return {k: int(recs[k][i]) for k in _FIELDS if k in recs}
+
+
+def happens_before(recs: dict) -> list[tuple[int, int]]:
+    """The resolvable happens-before edges of one lane's ring, as
+    (parent_step, child_step) dispatch-index pairs. `recs` is a
+    `ring_records()` dict; edges whose parent was overwritten by ring
+    wrap (or is external, parent == -1) are omitted — they exist in the
+    execution, just not in the surviving window."""
+    if "parent" not in recs:
+        raise ValueError("no lineage columns: state predates r10 or was "
+                         "built without cfg.trace_cap > 0")
+    steps = np.asarray(recs["step"])
+    present = set(steps.tolist())
+    return [(int(p), int(c)) for p, c in zip(recs["parent"], steps)
+            if int(p) >= 0 and int(p) in present]
+
+
+def explain_crash(state, lane: int = 0) -> dict:
+    """Walk parent edges backward from a lane's last recorded dispatch —
+    for a crashed lane, the crash dispatch (the invariant/deadlock check
+    runs inside the same step it implicates) — to the minimal causal
+    chain the ring still holds.
+
+    Returns a dict:
+      chain       list of event records, OLDEST first, ENDING at the
+                  crash dispatch; each carries step/now/kind/node/src/
+                  tag/parent/lamport
+      truncated   True when the walk hit a parent overwritten by ring
+                  wrap (the chain is a faithful SUFFIX of the full one)
+      root_external  True when the chain reached a parent of -1 — an
+                  external cause (scenario row / node boot / injection)
+      crashed / crash_code / crash_node   the lane's crash verdict
+      lane, dropped   lane index and ring-wrap overwrite count
+
+    Raises (via ring_records) if the ring is compiled out or the lane
+    was not sampled; raises ValueError on an empty ring or a pre-r10
+    state without lineage columns.
+    """
+    recs = ring_records(state, lane)
+    if "parent" not in recs:
+        raise ValueError("no lineage columns: state predates r10 or was "
+                         "built without cfg.trace_cap > 0")
+    n = len(np.asarray(recs["step"]))
+    if n == 0:
+        raise ValueError(f"lane {lane} recorded no events — nothing to "
+                         "explain (did the lane ever dispatch?)")
+    by_step = {int(s): i for i, s in enumerate(recs["step"])}
+    chain = []
+    i = n - 1                              # the lane's last dispatch
+    truncated = False
+    root_external = False
+    while True:
+        chain.append(_rec_at(recs, i))
+        parent = int(recs["parent"][i])
+        if parent < 0:
+            root_external = True
+            break
+        if parent not in by_step:          # overwritten by ring wrap
+            truncated = True
+            break
+        i = by_step[parent]
+    chain.reverse()
+
+    def _lane_scalar(leaf):
+        a = np.asarray(leaf)
+        return a[lane] if a.ndim else a
+
+    return dict(
+        chain=chain,
+        truncated=truncated,
+        root_external=root_external,
+        crashed=bool(_lane_scalar(state.crashed)),
+        crash_code=int(_lane_scalar(state.crash_code)),
+        crash_node=int(_lane_scalar(state.crash_node)),
+        lane=int(lane),
+        dropped=int(recs["dropped"]),
+    )
+
+
+def sketch_divergence(state, lane_a: int, lane_b: int) -> dict:
+    """Where two lanes' schedules first diverged, from their on-device
+    prefix-coverage sketches (cfg.sketch_slots > 0). Returns
+    {slot, step_bound, every, slots}: `slot` is the first sketch index
+    where the lanes differ (== slots when no recorded checkpoint
+    differs), and `step_bound` the corresponding upper bound on the
+    first divergent dispatch index — the lanes' first `slot * every`
+    dispatches hashed identically."""
+    sk = np.asarray(state.cov_sketch)
+    if sk.ndim != 2 or sk.shape[1] == 0:
+        raise ValueError("prefix sketch is compiled out "
+                         "(cfg.sketch_slots == 0) or state is unbatched")
+    every = int(np.atleast_1d(np.asarray(state.sketch_every)).reshape(-1)[0])
+    a, b = sk[lane_a], sk[lane_b]
+    differs = a != b
+    slots = sk.shape[1]
+    slot = int(differs.argmax()) if differs.any() else slots
+    return dict(slot=slot, step_bound=(slot + 1) * every, every=every,
+                slots=slots)

@@ -12,7 +12,12 @@ blocked on anyway. Record kinds (each a flat JSON-able dict carrying
   compact  run_compacting re-packed survivors: from_batch/to_batch/stashed
   round    one explore() round harvested: new_schedules, distinct_total,
            crashes — the per-round coverage growth off the existing
-           on-device digest
+           on-device digest. fuzz() rounds arrive as kind="fuzz_round"
+           with corpus_size/new_crash_codes, plus div_slot_p50 (the
+           round's median first-divergence slot vs the consensus prefix)
+           when the build compiles the prefix sketch in
+           (cfg.sketch_slots > 0) — depth telemetry riding the sketch
+           transfer the corpus already pays for
   compile  a runner retraced (= a fresh executable was built, modulo
            persistent-cache compile skips): label (chunk_runner /
            fused_runner / inject), batch, chunk. Fired by

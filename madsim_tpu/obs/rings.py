@@ -47,8 +47,10 @@ def sampled_lanes(state) -> np.ndarray:
 def ring_records(state, lane: int = 0) -> dict:
     """One lane's ring, unwrapped into chronological order (host-side).
 
-    Returns {now, step, kind, node, src, tag: int32[n], total: int,
-    dropped: int} where n = min(total, trace_cap), `total` is every event
+    Returns {now, step, kind, node, src, tag, parent, lamport: int32[n],
+    total: int, dropped: int} where n = min(total, trace_cap) (`parent`/
+    `lamport` are the causal-lineage pair, obs/causal.py — absent only
+    for pre-r10 states), `total` is every event
     the lane ever recorded and `dropped` counts ring-wrap overwrites
     (oldest-first). Raises if the runtime compiled the ring out or the
     lane was not sampled — a silent empty trace would read as "nothing
@@ -59,8 +61,12 @@ def ring_records(state, lane: int = 0) -> dict:
     _require_addressable(state, "ring_records")
     # OWNED host copies (utils/hostcopy): the returned columns are held
     # by the caller across later donated runs of the same state buffers —
-    # a zero-copy view would dangle (the PR-2 warm-cache bug class)
-    cols = {k: owned_host_copy(getattr(state, f"tr_{k}")) for k in _COLS}
+    # a zero-copy view would dangle (the PR-2 warm-cache bug class).
+    # Columns a state lacks (pre-r10 checkpoints, synthetic fixtures
+    # without the lineage pair) are simply absent from the record dict —
+    # consumers .get() them (obs/trace.py, obs/causal.py).
+    cols = {k: owned_host_copy(getattr(state, f"tr_{k}")) for k in _COLS
+            if hasattr(state, f"tr_{k}")}
     pos = np.asarray(state.trace_pos)
     on = np.asarray(state.trace_on)
     # LOGICAL capacity is the dynamic state operand (cfg.trace_cap);

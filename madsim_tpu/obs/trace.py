@@ -15,7 +15,11 @@ thread_name metadata), virtual-time microseconds on the time axis (the
 engine's tick IS a microsecond, so no scaling). Every dispatch renders as
 an instant event; supervisor ops (kill/restart/clog/...) land on the track
 of the node they act on, named "SUPER:<OP>", so a chaos script reads
-straight off the timeline.
+straight off the timeline. Ring sources with lineage columns (r10) also
+render message causality: every resolvable happens-before edge becomes a
+Perfetto flow arrow (`ph:"s"` at the enqueuing dispatch, `ph:"f"` at the
+child), and instant args carry step/lamport/parent for trace-side joins
+against `explain_crash` chains and divergence reports.
 """
 
 from __future__ import annotations
@@ -30,14 +34,14 @@ _KIND = {T.EV_MSG: "MSG", T.EV_TIMER: "TIMER", T.EV_SUPER: "SUPER"}
 _OP = {v: k[3:] for k, v in vars(T).items() if k.startswith("OP_")}
 
 
-def _event(now, kind, node, src, tag):
+def _event(now, kind, node, src, tag, **extra):
     k = _KIND.get(kind, f"?{kind}")
     if kind == T.EV_SUPER:
         name = f"SUPER:{_OP.get(tag, tag)}"
     else:
         name = f"{k}:tag{tag}"
     return dict(name=name, ph="i", s="t", ts=now, pid=0, tid=node,
-                args=dict(src=src, tag=tag))
+                args=dict(src=src, tag=tag, **extra))
 
 
 def _doc(events: list[dict], node_names=None) -> dict:
@@ -56,26 +60,71 @@ def to_chrome_events(source, b: int = 0) -> list[dict]:
     shaped [steps, batch, ...]; `b` selects the lane and `fired=False`
     frozen-lane records are dropped) or a `ring_records()` dict (already
     one lane, already only real dispatches).
+
+    Every instant event's `args` carries `step` — the dispatch index —
+    so Perfetto queries can join the timeline against divergence
+    reports and `explain_crash` chains (a stream's k-th `fired` record
+    IS dispatch k, matching the ring's `tr_step`). Ring sources with
+    lineage columns (r10) additionally carry `parent` and `lamport`,
+    and each resolvable happens-before edge is rendered as a Perfetto
+    FLOW arrow: a `ph:"s"` at the parent dispatch paired with a
+    `ph:"f"` at the child (id = the child's dispatch index), appended
+    after the instants.
     """
     if "fired" in source:                      # collect_events stream
         cols = {k: np.asarray(source[k])[:, b]
                 for k in ("fired", "now", "kind", "node", "src", "tag")}
         idx = np.nonzero(cols["fired"])[0]
-    else:                                      # ring_records dict
-        cols = source
-        idx = np.arange(len(np.asarray(cols["now"])))
-    return [_event(int(cols["now"][i]), int(cols["kind"][i]),
-                   int(cols["node"][i]), int(cols["src"][i]),
-                   int(cols["tag"][i]))
-            for i in idx]
+        return [_event(int(cols["now"][i]), int(cols["kind"][i]),
+                       int(cols["node"][i]), int(cols["src"][i]),
+                       int(cols["tag"][i]), step=k)
+                for k, i in enumerate(idx)]
+    cols = source                              # ring_records dict
+    n = len(np.asarray(cols["now"]))
+    steps = cols.get("step")
+    parents = cols.get("parent")
+    lamports = cols.get("lamport")
+    out = []
+    for i in range(n):
+        extra = {}
+        if steps is not None:
+            extra["step"] = int(steps[i])
+        if lamports is not None:
+            extra["lamport"] = int(lamports[i])
+        if parents is not None:
+            extra["parent"] = int(parents[i])
+        out.append(_event(int(cols["now"][i]), int(cols["kind"][i]),
+                          int(cols["node"][i]), int(cols["src"][i]),
+                          int(cols["tag"][i]), **extra))
+    if steps is not None and parents is not None:
+        # message causality as arrows on the per-node tracks: one flow
+        # start ("s") at the enqueuing dispatch, one finish ("f") at the
+        # child, bound by id = child dispatch index (each dispatch has
+        # exactly one parent). Edges whose parent fell off the ring are
+        # simply not drawn — the wrap contract (obs/causal.py).
+        present = {int(s): i for i, s in enumerate(steps)}
+        for i in range(n):
+            p = int(parents[i])
+            if p < 0 or p not in present:
+                continue
+            j = present[p]
+            flow = dict(name="causal", cat="causal", id=int(steps[i]),
+                        pid=0)
+            out.append(dict(flow, ph="s", ts=int(cols["now"][j]),
+                            tid=int(cols["node"][j])))
+            out.append(dict(flow, ph="f", bp="e", ts=int(cols["now"][i]),
+                            tid=int(cols["node"][i])))
+    return out
 
 
 def export_chrome_trace(path: str, events=None, b: int = 0,
                         state=None, lane: int = 0, node_names=None) -> int:
     """Write one lane's trace as Chrome/Perfetto JSON; returns the number
-    of (non-metadata) trace events written — which equals the lane's
-    `fired=True` record count (collect_events source) or its surviving
-    ring length (state source).
+    of INSTANT events written — which equals the lane's `fired=True`
+    record count (collect_events source) or its surviving ring length
+    (state source). Causal flow arrows (`ph:"s"/"f"` pairs, emitted for
+    ring sources with lineage columns) ride in the document but are not
+    counted — they annotate dispatches, they aren't dispatches.
 
     Pass exactly one source: `events` (+ `b`) from a
     `collect_events=True` run, or `state` (+ `lane`) to read the
@@ -91,4 +140,4 @@ def export_chrome_trace(path: str, events=None, b: int = 0,
         out = to_chrome_events(events, b)
     with open(path, "w") as f:
         json.dump(_doc(out, node_names), f)
-    return len(out)
+    return sum(1 for e in out if e["ph"] == "i")

@@ -73,6 +73,56 @@ def sched_hash_u64(state) -> np.ndarray:
     return (h[..., 0] << np.uint64(32)) | h[..., 1]
 
 
+def first_divergence_slots(sketches) -> np.ndarray:
+    """Per-lane first-divergence slot from a [B, S] prefix-sketch array
+    (SimState.cov_sketch): the first slot where a lane's sketch differs
+    from the slot's MODAL value — the batch's consensus prefix. Returns
+    int64[B] in [0, S]; S means the lane never left the consensus within
+    the recorded window (identical schedule, or divergence past slot S).
+    Host-side numpy over a [B, S] transfer — kilobytes, after the sweep;
+    the recording itself never left the device mid-run."""
+    sk = np.asarray(sketches)
+    B, S = sk.shape
+    if S == 0:
+        return np.zeros(B, np.int64)
+    mode = np.zeros(S, sk.dtype)
+    for j in range(S):
+        vals, counts = np.unique(sk[:, j], return_counts=True)
+        mode[j] = vals[np.argmax(counts)]
+    differs = sk != mode[None, :]
+    return np.where(differs.any(1), differs.argmax(1), S).astype(np.int64)
+
+
+def divergence_profile(state) -> dict | None:
+    """First-divergence-step percentiles across a sweep, from the
+    on-device prefix-coverage sketches (cfg.sketch_slots > 0): WHEN the
+    batch's schedules split, not just HOW MANY terminal classes they
+    reached (`distinct_schedules`). None when the sketch is compiled out
+    or the state is unbatched. Steps are upper bounds: a lane whose
+    first divergent slot is j matched the consensus prefix through slot
+    j-1's checkpoint, i.e. through (j)*sketch_every dispatches."""
+    sk = getattr(state, "cov_sketch", None)
+    if sk is None:
+        return None
+    sk = np.asarray(sk)
+    if sk.ndim != 2 or sk.shape[1] == 0:
+        return None
+    every = int(np.atleast_1d(np.asarray(state.sketch_every)).reshape(-1)[0])
+    first = first_divergence_slots(sk)
+    S = sk.shape[1]
+    div = first < S
+    out = dict(slots=S, every=every, batch=int(len(first)),
+               diverged=int(div.sum()))
+    if div.any():
+        steps = (first[div] + 1) * every
+        out.update(
+            p10=int(np.percentile(steps, 10)),
+            p50=int(np.percentile(steps, 50)),
+            p90=int(np.percentile(steps, 90)),
+            mean=round(float(steps.mean()), 1))
+    return out
+
+
 def schedule_representatives(state, seeds) -> dict:
     """{sched_hash: first seed that produced it} — one replayable
     representative per distinct interleaving class. After a sweep, replay
@@ -153,5 +203,11 @@ def summarize(rt, state, seeds=None) -> dict:
         # on-device reduction: one int32 crosses the host boundary, not
         # the [B] hash array.
         distinct_schedules=distinct_schedules(state),
+        # schedule-space coverage DEPTH (r10): when the batch's schedules
+        # first split, from the on-device prefix sketches — None when
+        # cfg.sketch_slots == 0. distinct_schedules says how many
+        # interleaving classes; first_divergence says how early the
+        # batch bought them.
+        first_divergence=divergence_profile(state),
         oops=int((np.asarray(state.oops) != 0).sum()),
     )

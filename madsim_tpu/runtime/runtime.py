@@ -547,12 +547,24 @@ class Runtime:
         from ..core import types as Ty
         from ..ops.select import first_k_free
 
+        cfg = self.cfg
+
         def one(state, op, node, src, payload):
             free = state.t_kind == Ty.EV_FREE
             slots, ok = first_k_free(free, 1)
             slot, ok = slots[0], ok[0]
             w = ok & ~state.halted
+            lineage = {}
+            if cfg.trace_cap > 0:
+                # host-injected ops are EXTERNAL causes (parent -1,
+                # carried clock 0) — without this the reused slot would
+                # keep a stale parent from its previous occupant
+                lineage = dict(
+                    ev_prov=state.ev_prov.at[slot].set(
+                        jnp.where(w, jnp.asarray([-1, 0], jnp.int32),
+                                  state.ev_prov[slot])))
             return state.replace(
+                **lineage,
                 t_deadline=state.t_deadline.at[slot].set(
                     jnp.where(w, state.now, state.t_deadline[slot])),
                 t_kind=state.t_kind.at[slot].set(

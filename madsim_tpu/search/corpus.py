@@ -18,20 +18,28 @@ setting needs):
     entries fade instead of starving newcomers;
   - `schedule()` samples parents with probability proportional to energy,
     and keeps `fresh_frac` of each batch on the UNMUTATED base knobs — an
-    exploration floor so the corpus never traps the sweep in one basin.
+    exploration floor so the corpus never traps the sweep in one basin;
+  - (r10) lanes that diverged from the round's consensus prefix EARLY get
+    an admission bonus scaled by depth (up to x(1+div_bonus)), computed
+    from the on-device prefix-coverage sketches (SimState.cov_sketch):
+    an early split means the mutation rewired the schedule near its
+    root, and everything downstream of it is new territory — the
+    per-prefix signal the terminal sched_hash alone cannot see.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from ..parallel.stats import first_divergence_slots
 from .mutate import KnobPlan
 
 
 class Corpus:
     def __init__(self, plan: KnobPlan, rng=None, max_entries: int = 4096,
                  fresh_frac: float = 0.125, decay: float = 0.97,
-                 reward: float = 1.5, energy_cap: float = 8.0):
+                 reward: float = 1.5, energy_cap: float = 8.0,
+                 div_bonus: float = 1.0):
         self.plan = plan
         self.rng = rng if rng is not None else np.random.default_rng(0)
         self.max_entries = int(max_entries)
@@ -39,6 +47,7 @@ class Corpus:
         self.decay = float(decay)
         self.reward = float(reward)
         self.energy_cap = float(energy_cap)
+        self.div_bonus = float(div_bonus)   # 0 = sched_hash-only energy
         self.entries: list[dict] = []   # slot-stable: eviction replaces
         self._seen: set[int] = set()    # every hash ever admitted (dedupe)
         self.crash_codes: set[int] = set()
@@ -55,14 +64,23 @@ class Corpus:
 
     # ------------------------------------------------------------------
     def observe(self, knobs_batch, seeds, hashes_u64, crashed, codes,
-                parent_ids, round_no: int) -> dict:
+                parent_ids, round_no: int, sketches=None) -> dict:
         """Fold one harvested round into the corpus. `knobs_batch` is the
         HOST knob batch that ran, `hashes_u64` the per-lane schedule
         hashes, `parent_ids` the corpus entry id each lane mutated from
-        (schedule()'s ids; -1 for base/bootstrap lanes). Returns
+        (schedule()'s ids; -1 for base/bootstrap lanes), `sketches` the
+        optional [B, S] prefix-coverage sketch batch (SimState.cov_sketch
+        — enables the early-divergence admission bonus). Returns
         admission stats."""
         new = 0
         new_crash_codes = []
+        div_slot = None
+        n_slots = 0
+        if sketches is not None and self.div_bonus > 0:
+            sk = np.asarray(sketches)
+            if sk.ndim == 2 and sk.shape[1] > 0:
+                div_slot = first_divergence_slots(sk)
+                n_slots = sk.shape[1]
         for e in self.entries:
             e["energy"] = max(0.05, e["energy"] * self.decay)
         for i in range(len(seeds)):
@@ -75,10 +93,19 @@ class Corpus:
                 continue
             self._seen.add(h)
             new += 1
+            energy = 3.0 if hit_crash else 1.0
+            slot = None
+            if div_slot is not None:
+                # early-divergence bonus: a lane whose schedule left the
+                # round's consensus prefix at slot j gets up to
+                # x(1 + div_bonus) admission energy, linear in how early
+                # (j == n_slots — never diverged in-window — gets none)
+                slot = int(div_slot[i])
+                energy *= 1.0 + self.div_bonus * (n_slots - slot) / n_slots
             entry = dict(id=self._next_id, hash=h, seed=int(seeds[i]),
                          knobs=KnobPlan.lane(knobs_batch, i),
-                         energy=3.0 if hit_crash else 1.0,
-                         round=int(round_no),
+                         energy=min(self.energy_cap, energy),
+                         round=int(round_no), div_slot=slot,
                          crash_code=int(codes[i]) if hit_crash else 0)
             self._next_id += 1
             self._by_id[entry["id"]] = entry

@@ -39,7 +39,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
          dry_rounds: int = 3, base_seed: int = 0, chunk: int = 512,
          pipeline: bool = True, fused: bool = True, dup_slots: int = 2,
          havoc: int = 3, fresh_frac: float = 0.125, rng_seed: int = 0,
-         observer=None, minimize: bool = False, corpus: Corpus | None = None):
+         observer=None, minimize: bool = False, corpus: Corpus | None = None,
+         div_bonus: float | None = None):
     """Coverage-guided schedule fuzzing over `rt`'s dynamic fault knobs.
 
     Round 0 is a blind bootstrap (base knobs, fresh seeds — one explore()
@@ -53,7 +54,12 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     (exploration floor of unmutated lanes per round), rng_seed (corpus
     scheduling + mutation randomness — the whole campaign is replayable),
     minimize (auto-shrink each crash repro's fault rows), corpus (pass a
-    prior campaign's corpus to continue it).
+    prior campaign's corpus to continue it), div_bonus (early-divergence
+    admission-energy bonus when the runtime compiles the prefix sketch
+    in, cfg.sketch_slots > 0 — see search/corpus.py; 0 restores
+    sched_hash-only energy, a sketchless build is always hash-only
+    regardless, and None keeps the corpus's setting — the default 1.0
+    for a fresh corpus, whatever a passed-in `corpus` was built with).
 
     observer: obs.metrics.SweepObserver — `on_round` records of kind
     "fuzz_round" (explore's round schema + corpus_size/new_crash_codes),
@@ -72,8 +78,15 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
       minimized         {code: minimize_knobs info} when minimize=True
     """
     plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
-    corpus = corpus if corpus is not None else Corpus(
-        plan, rng=np.random.default_rng(rng_seed), fresh_frac=fresh_frac)
+    if corpus is None:
+        corpus = Corpus(plan, rng=np.random.default_rng(rng_seed),
+                        fresh_frac=fresh_frac,
+                        div_bonus=1.0 if div_bonus is None else div_bonus)
+    elif div_bonus is not None:
+        # an explicit div_bonus must win over a passed-in corpus's
+        # setting — silently keeping the old value would skew any
+        # hash-only-vs-divergence comparison run through this arg
+        corpus.div_bonus = float(div_bonus)
     master = jax.random.PRNGKey(np.uint32(rng_seed ^ 0x5EED5EED))
     op_hist = np.zeros(N_MUT_OPS, np.int64)
 
@@ -100,15 +113,19 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     def harvest(launched):
         """Block on one round. Transfers the [B] hash/crash lanes plus
         the knob batch (kilobytes — the corpus needs per-lane
-        attribution, unlike explore()'s O(distinct) digest)."""
+        attribution, unlike explore()'s O(distinct) digest) and, when
+        the build compiles the prefix sketch in, the [B, S] sketch
+        batch (also kilobytes — the divergence-depth signal)."""
         seeds, ids, knobs_dev, hist, state = launched
         knobs_host = {k: np.asarray(v) for k, v in knobs_dev.items()}
         hashes = stats.sched_hash_u64(state)
+        sk = np.asarray(state.cov_sketch)
+        sketches = sk if sk.ndim == 2 and sk.shape[1] > 0 else None
         if hist is not None:
             op_hist[:] += np.asarray(hist)
         return (seeds, ids, knobs_host, hashes,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
-                hist is not None)
+                hist is not None, sketches)
 
     seen: set[int] = set()
     crashes: dict[int, int] = {}
@@ -123,10 +140,10 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     for r in range(max_rounds):
         nxt = (launch(r + 1) if speculate and r + 1 < max_rounds else None)
         (seeds, ids, knobs_host, hashes, crashed, codes,
-         mutated) = harvest(pending)
+         mutated, sketches) = harvest(pending)
         rounds += 1
         cstats = corpus.observe(knobs_host, seeds, hashes, crashed, codes,
-                                ids, r)
+                                ids, r, sketches=sketches)
         for i in np.nonzero(crashed)[0]:
             c = int(codes[i])
             if not mutated:     # seed-alone handles: bootstrap lanes only
@@ -141,13 +158,21 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         new_per_round.append(len(fresh))
         dry = dry + 1 if not fresh else 0
         if observer is not None:
-            observer.on_round(dict(
+            rec = dict(
                 kind="fuzz_round", round=rounds, batch=batch,
                 seeds_run=rounds * batch, new_schedules=len(fresh),
                 distinct_total=len(seen), crashes=n_crashed,
                 corpus_size=cstats["size"],
                 new_crash_codes=cstats["new_crash_codes"],
-                dry_rounds=dry, wall_s=time.perf_counter() - t0))
+                dry_rounds=dry, wall_s=time.perf_counter() - t0)
+            if sketches is not None:
+                # divergence depth of this round's mutants (median
+                # first-divergence slot vs the consensus prefix): how
+                # early the round's schedule rewiring bit, off the
+                # sketch transfer the corpus already paid for
+                rec["div_slot_p50"] = int(np.median(
+                    stats.first_divergence_slots(sketches)))
+            observer.on_round(rec)
         if dry >= dry_rounds:
             break
         pending = nxt if nxt is not None else (

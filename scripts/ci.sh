@@ -42,6 +42,11 @@ case "$tier" in
     # blind explore() on the saturating workload, exercise the mutation
     # operators, and enumerate PCT tie-break policies
     python bench.py --search-smoke
+    # causal-lineage smoke: lineage/sketch compiled in but masked off
+    # must not perturb trajectories, a fuzzer-harvested crash must
+    # explain itself (parent chain + Perfetto flow arrows), and the
+    # divergence profile must come back from the on-device sketches
+    python bench.py --causal-smoke
     if [[ "${2:-}" == "--compile-smoke" ]]; then
       # shared step-program cache smoke: two structurally-equal configs
       # must cost exactly one retrace and stay bitwise-equal to a
