@@ -852,6 +852,189 @@ def _search_smoke_mode():
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
+def _campaign_mode():
+    """--mode campaign: persistent multi-process fuzzing campaign A/B
+    (service/campaign.py) at 1 vs 2 workers, EQUAL per-worker budget
+    (same rounds x batch x max_steps each), on the crash-rich wal_kv
+    matrix. Workers are CPU subprocesses sharing a corpus dir and the r8
+    persistent compile cache; rates use the workers' own fuzz wall
+    (max across workers — they overlap), with driver uptime (startup +
+    compile included) reported alongside. Writes BENCH_campaign_cpu.json:
+    schedules/s and buckets/min per worker count, plus the cross-process
+    dedup evidence (crash observations vs merged buckets)."""
+    _force_cpu_inprocess()
+    import shutil
+    import tempfile
+    from madsim_tpu.service import run_campaign
+    factory = "bench:_make_crashrich_runtime"
+    fkw = dict(kind="wal_kv", trace_cap=64, sketch_slots=4)
+    kw = dict(max_steps=4096, batch=48, max_rounds=3, chunk=512)
+    out = {"metric": "campaign", "platform": "cpu",
+           "workload": dict(factory=factory, **fkw, **kw),
+           "note": ("equal PER-WORKER budget: the 2-worker campaign "
+                    "explores twice the schedules; linear scaling in "
+                    "worker_wall-relative schedules/s is the merge-by-"
+                    "construction claim (coverage dedup costs no locks). "
+                    "buckets_merged counts bugs after the read-side "
+                    "suffix merge — crash_observations above it is the "
+                    "cross-process dedup doing its job. CPU numbers "
+                    "until the TPU tunnel answers (ROADMAP wishlist: "
+                    "--mode campaign)"),
+           "runs": {}}
+    root = tempfile.mkdtemp(prefix="madsim_campaign_bench_")
+    env = _cpu_env()
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    try:
+        # warm the shared compile cache so neither measured run eats the
+        # one-time cold compile
+        run_campaign(factory, os.path.join(root, "warm"), workers=1,
+                     factory_kwargs=fkw, env=env,
+                     **dict(kw, max_rounds=1))
+        for n in (1, 2):
+            d = os.path.join(root, f"w{n}")
+            t0 = time.perf_counter()
+            rep = run_campaign(factory, d, workers=n,
+                               factory_kwargs=fkw, env=env, **kw)
+            for w, res in rep["worker_results"].items():
+                # a dead worker would silently shrink the measured side
+                # into a wrong "no scaling" artifact — fail loudly
+                assert res["returncode"] == 0, (n, w, res)
+            out["runs"][f"workers_{n}"] = {
+                "coverage_keys": rep["coverage_keys"],
+                "corpus_entries": rep["corpus_entries"],
+                "buckets_merged": rep["buckets_merged"],
+                "crash_observations": rep["crash_observations"],
+                "schedules_per_sec": rep["schedules_per_sec"],
+                "buckets_per_min": rep["buckets_per_min"],
+                "worker_wall_s": rep["worker_wall_s"],
+                "driver_uptime_s": round(time.perf_counter() - t0, 1),
+            }
+            print(f"--campaign: {n} worker(s): "
+                  f"{rep['coverage_keys']} coverage keys, "
+                  f"{rep['buckets_merged']} buckets, "
+                  f"{rep['schedules_per_sec']}/s", file=sys.stderr)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    r1, r2 = out["runs"]["workers_1"], out["runs"]["workers_2"]
+    out["coverage_scaling_2x"] = round(
+        r2["coverage_keys"] / max(r1["coverage_keys"], 1), 2)
+    out["schedules_per_sec_scaling_2x"] = round(
+        r2["schedules_per_sec"] / max(r1["schedules_per_sec"], 1e-9), 2)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_campaign_cpu.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _campaign_smoke_mode():
+    """--campaign-smoke: seconds-scale persistent-campaign self-test for
+    CI (wired into scripts/ci.sh fast). Three contracts, with CPU-forced
+    subprocess workers sharing the persistent compile cache:
+
+      merge       two CONCURRENT workers on one corpus dir -> merged
+                  corpus carries both id namespaces, and the crash
+                  harvests dedup into shared causal-fingerprint buckets
+                  (at least one bucket observed by both processes;
+                  observations strictly exceed merged buckets)
+      durability  SIGKILL a 1-worker campaign mid-run, resume it from
+                  the corpus dir, and the final coverage keys, entry
+                  files, and bucket set EQUAL an uninterrupted control
+                  run with the same seeds (the acceptance proof)
+      reject      the dir refuses a structurally different runtime
+                  (version/signature contract)
+    """
+    _force_cpu_inprocess()
+    import shutil
+    import signal as _signal
+    import subprocess as _sp
+    import tempfile
+    from madsim_tpu.service import (CorpusStore, StoreMismatch,
+                                    campaign_report, run_campaign,
+                                    spawn_worker, worker_cmd)
+    t0 = time.perf_counter()
+    factory = "bench:_make_crashrich_runtime"
+    fkw = dict(kind="wal_kv", trace_cap=64, sketch_slots=4)
+    kw = dict(max_steps=4096, batch=16, max_rounds=2, chunk=512)
+    root = tempfile.mkdtemp(prefix="madsim_campaign_smoke_")
+    env = _cpu_env()
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    try:
+        # -- merge + dedup across two concurrent processes --------------
+        d1 = os.path.join(root, "merge")
+        rep = run_campaign(factory, d1, workers=2, factory_kwargs=fkw,
+                           env=env, poll_s=1.0, **kw)
+        for w, res in rep["worker_results"].items():
+            assert res["returncode"] == 0, (w, res)
+        store = CorpusStore(d1, create=False)
+        namespaces = {n.split("-")[0] for n in store.entry_names()}
+        assert namespaces == {"w0000", "w0001"}, namespaces
+        # entries can transiently exceed coverage when two workers admit
+        # one hash before their next merge sync — never the reverse
+        assert 0 < rep["coverage_keys"] <= rep["corpus_entries"]
+        assert rep["buckets_merged"] >= 1, rep
+        assert rep["crash_observations"] > rep["buckets_merged"], rep
+        by_bucket = {}
+        for line in store.bucket_log():
+            by_bucket.setdefault(line["bucket"], set()).add(
+                line["worker_id"])
+        assert any(len(ws) == 2 for ws in by_bucket.values()), (
+            "no bucket was observed by both workers", by_bucket)
+        # -- durability: SIGKILL mid-campaign, resume, compare ----------
+        dk = os.path.join(root, "kill")
+        dc = os.path.join(root, "ctrl")
+        kwk = dict(kw, max_rounds=3)
+        p = spawn_worker(dk, 0, factory, factory_kwargs=fkw, env=env,
+                         **kwk)
+        state_path = os.path.join(dk, "state", "w0000.json")
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if os.path.exists(state_path):
+                break
+            if p.poll() is not None:
+                raise AssertionError("worker exited before first sync")
+            time.sleep(0.2)
+        else:
+            raise AssertionError("no sync within 300s")
+        p.send_signal(_signal.SIGKILL)
+        p.wait()
+        killed_at = json.load(open(state_path))["rounds_done"]
+        # resume to the campaign total; control runs uninterrupted
+        for d in (dk, dc):
+            _sp.run(worker_cmd(d, 0, factory, factory_kwargs=fkw, **kwk),
+                    env=env, check=True, stdout=_sp.DEVNULL)
+        sk, sc_ = CorpusStore(dk, create=False), CorpusStore(
+            dc, create=False)
+        assert sk.coverage_keys() == sc_.coverage_keys()
+        assert sk.entry_names() == sc_.entry_names()
+        assert sk.bucket_keys() == sc_.bucket_keys()
+        assert json.load(open(state_path))["rounds_done"] == 3
+        # -- signature reject -------------------------------------------
+        from madsim_tpu.search.mutate import KnobPlan
+        from madsim_tpu.service import store_signature
+        other = _make_crashrich_runtime("chain", trace_cap=64)
+        try:
+            CorpusStore(d1, signature=store_signature(
+                other, KnobPlan.from_runtime(other)))
+            raise AssertionError("structurally different runtime was "
+                                 "not rejected")
+        except StoreMismatch:
+            pass
+        print(json.dumps({
+            "metric": "campaign_smoke", "platform": "cpu", "ok": True,
+            "merged_coverage": rep["coverage_keys"],
+            "buckets_merged": rep["buckets_merged"],
+            "crash_observations": rep["crash_observations"],
+            "killed_at_round": killed_at,
+            "resume_matches_uninterrupted": True,
+            "wall_s": round(time.perf_counter() - t0, 1)}))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _make_raft_compile_matrix_runtime(time_limit, loss, lat_hi,
                                       share: bool):
     """One cell of the compile_ab matrix: the flagship Raft step program
@@ -1514,11 +1697,18 @@ def main():
                  "--scaling", "--cpu-baseline", "--native-baseline",
                  "--obs-ab", "--obs-smoke", "--compile-ab",
                  "--compile-smoke", "--search-ab", "--search-smoke",
-                 "--causal-ab", "--causal-smoke"}
+                 "--causal-ab", "--causal-smoke", "--campaign",
+                 "--campaign-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
         sys.argv.append(flag)
+    if "--campaign-smoke" in sys.argv:
+        _campaign_smoke_mode()
+        return
+    if "--campaign" in sys.argv:
+        _campaign_mode()
+        return
     if "--causal-ab" in sys.argv:
         _causal_ab_mode()
         return

@@ -43,6 +43,8 @@ from .parallel.stats import (divergence_profile, schedule_representatives,
 from .runtime.runtime import Runtime
 from .runtime.scenario import Scenario
 from .search import Corpus, KnobPlan, fuzz, pct_sweep, with_prio_nudge
+from .service import (CorpusStore, campaign_report, merged_buckets,
+                      replay_bucket, run_campaign)
 
 __version__ = "0.1.0"
 
@@ -56,4 +58,6 @@ __all__ = [
     "fuzz", "Corpus", "KnobPlan", "pct_sweep", "with_prio_nudge",
     "SweepObserver", "JsonlObserver", "ProgressObserver", "ring_records",
     "export_chrome_trace", "explain_crash", "divergence_profile",
+    "CorpusStore", "run_campaign", "campaign_report", "merged_buckets",
+    "replay_bucket",
 ]

@@ -26,7 +26,8 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   first split from the on-device prefix sketches.
 """
 
-from .causal import explain_crash, happens_before, sketch_divergence
+from .causal import (causal_fingerprint, code_fingerprint, explain_crash,
+                     fingerprints_match, happens_before, sketch_divergence)
 from .metrics import JsonlObserver, SweepObserver, TeeObserver
 from .progress import ProgressObserver
 from .rings import ring_records, sampled_lanes
@@ -37,4 +38,5 @@ __all__ = [
     "ring_records", "sampled_lanes", "to_chrome_events",
     "export_chrome_trace",
     "explain_crash", "happens_before", "sketch_divergence",
+    "causal_fingerprint", "code_fingerprint", "fingerprints_match",
 ]

@@ -24,12 +24,19 @@ O(trace_cap) transfer after the sweep, nothing during it.
 
 from __future__ import annotations
 
+import hashlib
+
 import numpy as np
 
 from .rings import ring_records
 
 # record fields copied into chain/edge dicts (lineage pair included)
 _FIELDS = ("step", "now", "kind", "node", "src", "tag", "parent", "lamport")
+
+# how many chain records (counted back from the crash dispatch) the
+# fingerprint covers by default — deep enough to separate bugs that share
+# a crash code, shallow enough that modest rings still reach full depth
+FINGERPRINT_DEPTH = 8
 
 
 def _rec_at(recs: dict, i: int) -> dict:
@@ -111,6 +118,103 @@ def explain_crash(state, lane: int = 0) -> dict:
         lane=int(lane),
         dropped=int(recs["dropped"]),
     )
+
+
+def _chain_tokens(chain: list[dict]) -> list[tuple]:
+    """The lane- and wrap-invariant content of a chain record: what the
+    event WAS (kind/node/src/tag), never WHEN it ran (step, now, lamport
+    are all shifted by seed and wrap point — hashing them would split one
+    bug into a bucket per lane)."""
+    return [(int(c["kind"]), int(c["node"]), int(c["src"]), int(c["tag"]))
+            for c in chain]
+
+
+def _digest(crash_sig: tuple, toks: list[tuple], marker: str = "") -> str:
+    blob = repr((crash_sig, toks, marker)).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def causal_fingerprint(exp: dict, depth: int = FINGERPRINT_DEPTH) -> dict:
+    """Hash an `explain_crash` chain into a crash-dedup fingerprint:
+    one bug = one bucket, across lanes, seeds, and processes.
+
+    The chain is consumed SUFFIX-first (the records nearest the crash),
+    because that end is wrap-stable: ring wrap truncates chains at the
+    ROOT end, so two observations of one bug truncated at different wrap
+    points share their deepest suffix (obs/causal.py wrap contract — a
+    chain is always a faithful suffix). The fingerprint therefore covers
+    the last `depth` records plus the crash verdict (code, node), and
+    carries the ladder of progressive suffix digests so a SHORTER
+    truncated chain of the same bug can still be matched to the bucket
+    (`fingerprints_match`) instead of opening a second one.
+
+    The `truncated` flag is folded in honestly, as COMPLETENESS: a chain
+    that reached its external root within `depth` records hashes a root
+    marker (its causal history is the whole story), while a chain cut by
+    wrap truncation — or by the depth cap itself — does not. Two complete
+    chains of different length are different bugs even when their
+    suffixes agree; a cut chain can never be distinguished from a deeper
+    one on suffix evidence alone, so it matches by deepest common suffix.
+
+    Returns {key, suffix_hashes, depth, complete, crash_code, crash_node,
+    kind="causal"}: `key` is the canonical bucket id for THIS observation
+    (the deepest digest, root marker folded in when complete), and
+    `suffix_hashes[k-1]` the digest of the last k records — the match
+    ladder. Raises ValueError on an empty chain.
+    """
+    chain = exp["chain"]
+    if not chain:
+        raise ValueError("cannot fingerprint an empty causal chain")
+    crash_sig = (int(exp["crash_code"]), int(exp["crash_node"]))
+    toks = _chain_tokens(chain)[-depth:]
+    complete = (bool(exp["root_external"]) and not bool(exp["truncated"])
+                and len(chain) <= depth)
+    suffix_hashes = [_digest(crash_sig, toks[len(toks) - k:])
+                     for k in range(1, len(toks) + 1)]
+    key = _digest(crash_sig, toks, marker="root" if complete else "cut")
+    return dict(key=key, suffix_hashes=suffix_hashes, depth=len(toks),
+                complete=complete, crash_code=crash_sig[0],
+                crash_node=crash_sig[1], kind="causal")
+
+
+def code_fingerprint(crash_code: int, crash_node: int) -> dict:
+    """The degraded fingerprint for lineage-less builds (cfg.trace_cap ==
+    0): dedup by crash verdict alone. Same schema as `causal_fingerprint`
+    so bucket stores handle both; `kind="code"` marks the lower
+    resolution (distinct bugs sharing a code WILL share a bucket)."""
+    key = f"code-{int(crash_code):08x}-n{int(crash_node)}"
+    return dict(key=key, suffix_hashes=[], depth=0, complete=False,
+                crash_code=int(crash_code), crash_node=int(crash_node),
+                kind="code")
+
+
+def fingerprints_match(a: dict, b: dict) -> bool:
+    """Whether two fingerprints denote the same bug — the deepest-common-
+    suffix rule. Equal keys always match. Otherwise two causal
+    fingerprints match when their suffix digests agree at the deepest
+    depth BOTH observed, unless both chains are complete (both reached
+    their external root: different depths then mean genuinely different
+    causal histories, not different wrap points)."""
+    if a["key"] == b["key"]:
+        return True
+    if a.get("kind") != "causal" or b.get("kind") != "causal":
+        return False
+    if a["complete"] and b["complete"]:
+        return False
+    # a cut chain as long as (or longer than) a complete one cannot be
+    # the same bug: the complete chain is the bug's WHOLE history, and a
+    # cut chain always hides at least one more record than it shows
+    # (truncation fires only when a parent existed but was overwritten,
+    # and the depth cap only when deeper records existed) — so a same-bug
+    # cut observation is strictly shorter than the complete chain
+    if a["complete"] and b["depth"] >= a["depth"]:
+        return False
+    if b["complete"] and a["depth"] >= b["depth"]:
+        return False
+    m = min(a["depth"], b["depth"])
+    if m == 0:
+        return False
+    return a["suffix_hashes"][m - 1] == b["suffix_hashes"][m - 1]
 
 
 def sketch_divergence(state, lane_a: int, lane_b: int) -> dict:

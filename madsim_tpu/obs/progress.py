@@ -59,6 +59,17 @@ class ProgressObserver:
         self.stream.write("\n")
 
     def on_round(self, rec):
+        if rec.get("kind") == "campaign":
+            # the multi-process campaign rollup (service/campaign.py):
+            # one line per poll of the shared corpus dir
+            self._show(
+                f"campaign {rec['uptime_s']:>5.0f}s  "
+                f"{rec['workers_alive']}/{rec['workers']} workers  "
+                f"corpus {rec['corpus_entries']}  "
+                f"coverage {rec['coverage_keys']}  "
+                f"buckets {rec['buckets']}  "
+                f"{rec['schedules_per_sec']:.1f} sched/s", force=True)
+            return
         # explore() rounds and fuzz() rounds share the schema; fuzz adds
         # corpus_size (and kind="fuzz_round")
         corpus = (f"  corpus {rec['corpus_size']}"

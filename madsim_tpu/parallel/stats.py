@@ -73,23 +73,28 @@ def sched_hash_u64(state) -> np.ndarray:
     return (h[..., 0] << np.uint64(32)) | h[..., 1]
 
 
-def first_divergence_slots(sketches) -> np.ndarray:
+def first_divergence_slots(sketches, consensus=None) -> np.ndarray:
     """Per-lane first-divergence slot from a [B, S] prefix-sketch array
     (SimState.cov_sketch): the first slot where a lane's sketch differs
-    from the slot's MODAL value — the batch's consensus prefix. Returns
-    int64[B] in [0, S]; S means the lane never left the consensus within
-    the recorded window (identical schedule, or divergence past slot S).
-    Host-side numpy over a [B, S] transfer — kilobytes, after the sweep;
-    the recording itself never left the device mid-run."""
+    from the consensus prefix — by default the BATCH's per-slot modal
+    value (ties to the smallest value, np.unique order); pass
+    `consensus` (uint32[S]) to measure against another reference, e.g.
+    the corpus's cross-round campaign consensus (search/corpus.py).
+    Returns int64[B] in [0, S]; S means the lane never left the
+    consensus within the recorded window (identical schedule, or
+    divergence past slot S). Host-side numpy over a [B, S] transfer —
+    kilobytes, after the sweep; the recording itself never left the
+    device mid-run."""
     sk = np.asarray(sketches)
     B, S = sk.shape
     if S == 0:
         return np.zeros(B, np.int64)
-    mode = np.zeros(S, sk.dtype)
-    for j in range(S):
-        vals, counts = np.unique(sk[:, j], return_counts=True)
-        mode[j] = vals[np.argmax(counts)]
-    differs = sk != mode[None, :]
+    if consensus is None:
+        consensus = np.zeros(S, sk.dtype)
+        for j in range(S):
+            vals, counts = np.unique(sk[:, j], return_counts=True)
+            consensus[j] = vals[np.argmax(counts)]
+    differs = sk != np.asarray(consensus)[None, :]
     return np.where(differs.any(1), differs.argmax(1), S).astype(np.int64)
 
 

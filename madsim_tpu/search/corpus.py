@@ -19,12 +19,24 @@ setting needs):
   - `schedule()` samples parents with probability proportional to energy,
     and keeps `fresh_frac` of each batch on the UNMUTATED base knobs — an
     exploration floor so the corpus never traps the sweep in one basin;
-  - (r10) lanes that diverged from the round's consensus prefix EARLY get
-    an admission bonus scaled by depth (up to x(1+div_bonus)), computed
-    from the on-device prefix-coverage sketches (SimState.cov_sketch):
-    an early split means the mutation rewired the schedule near its
-    root, and everything downstream of it is new territory — the
-    per-prefix signal the terminal sched_hash alone cannot see.
+  - (r10) lanes that diverged from the campaign's consensus prefix EARLY
+    get an admission bonus scaled by depth (up to x(1+div_bonus)),
+    computed from the on-device prefix-coverage sketches
+    (SimState.cov_sketch): an early split means the mutation rewired the
+    schedule near its root, and everything downstream of it is new
+    territory — the per-prefix signal the terminal sched_hash alone
+    cannot see. (r11) The consensus prefix is CROSS-ROUND: per-slot value
+    counts accumulate over every observed round (and, through the
+    durable store, every prior campaign segment), so novelty is judged
+    against the whole campaign's history, not just the current batch —
+    the ROADMAP follow-on the r10 per-round modal left open.
+
+Multi-process namespacing (r11): entry ids carry the worker id in their
+high bits (`worker_id << _ID_SHIFT | counter`), so two workers sharing a
+corpus dir can never mint colliding ids — the by-id parent-reward and
+eviction attribution stays sound when entries merge across processes
+(a foreign parent id either resolves to the merged copy or to nobody,
+never to the wrong entry).
 """
 
 from __future__ import annotations
@@ -34,12 +46,21 @@ import numpy as np
 from ..parallel.stats import first_divergence_slots
 from .mutate import KnobPlan
 
+# entry id = (worker_id << _ID_SHIFT) | per-worker monotonic counter.
+# 2^40 admissions per worker and 2^23 workers fit int64 with headroom.
+_ID_SHIFT = 40
+
+
+def split_entry_id(eid: int) -> tuple[int, int]:
+    """(worker_id, counter) of a namespaced entry id."""
+    return int(eid) >> _ID_SHIFT, int(eid) & ((1 << _ID_SHIFT) - 1)
+
 
 class Corpus:
     def __init__(self, plan: KnobPlan, rng=None, max_entries: int = 4096,
                  fresh_frac: float = 0.125, decay: float = 0.97,
                  reward: float = 1.5, energy_cap: float = 8.0,
-                 div_bonus: float = 1.0):
+                 div_bonus: float = 1.0, worker_id: int = 0):
         self.plan = plan
         self.rng = rng if rng is not None else np.random.default_rng(0)
         self.max_entries = int(max_entries)
@@ -48,6 +69,7 @@ class Corpus:
         self.reward = float(reward)
         self.energy_cap = float(energy_cap)
         self.div_bonus = float(div_bonus)   # 0 = sched_hash-only energy
+        self.worker_id = int(worker_id)
         self.entries: list[dict] = []   # slot-stable: eviction replaces
         self._seen: set[int] = set()    # every hash ever admitted (dedupe)
         self.crash_codes: set[int] = set()
@@ -55,12 +77,92 @@ class Corpus:
         # schedule() hands out ids and observe() rewards through this map,
         # so an eviction (same round or, under the pipelined loop, a later
         # one) can never hand a stale parent's reward to the slot's fresh
-        # occupant — the reward just finds nobody
-        self._next_id = 0
+        # occupant — the reward just finds nobody. Ids are namespaced by
+        # worker (see module docstring), so the same holds across
+        # processes sharing a durable corpus dir.
+        self._next_id = self.worker_id << _ID_SHIFT
         self._by_id: dict[int, dict] = {}
+        # cross-round consensus prefix: per-slot {sketch value: count}
+        # over every lane ever observed (kilobytes of host bookkeeping;
+        # serialized with the corpus by service/store.py)
+        self._slot_counts: list[dict[int, int]] | None = None
+        # durable-store hook: when a CorpusStore drives this corpus it
+        # flips this on so entries evicted BETWEEN two syncs are still
+        # persisted (their coverage keys are part of _seen and must
+        # survive a resume); off by default so in-memory campaigns don't
+        # accumulate dead entries
+        self.track_evictions = False
+        self.evicted_unsynced: list[dict] = []
 
     def __len__(self) -> int:
         return len(self.entries)
+
+    # ------------------------------------------------------------------
+    def coverage_keys(self) -> set[int]:
+        """Every sched_hash ever admitted (a copy): the corpus's coverage
+        frontier — survives evictions, merges across workers."""
+        return set(self._seen)
+
+    def consensus_sketch(self) -> np.ndarray | None:
+        """The campaign's consensus prefix: per-slot modal sketch value
+        over every observed round (ties break to the smallest value, the
+        `parallel.stats.first_divergence_slots` rule). None before any
+        sketched round was observed."""
+        if self._slot_counts is None:
+            return None
+        out = np.zeros(len(self._slot_counts), np.uint32)
+        for j, counts in enumerate(self._slot_counts):
+            # max count, ties to smallest value — sort keys first
+            best_v, best_c = 0, -1
+            for v in sorted(counts):
+                if counts[v] > best_c:
+                    best_v, best_c = v, counts[v]
+            out[j] = best_v
+        return out
+
+    def _fold_sketches(self, sk: np.ndarray) -> None:
+        if self._slot_counts is None:
+            self._slot_counts = [dict() for _ in range(sk.shape[1])]
+        for j in range(sk.shape[1]):
+            counts = self._slot_counts[j]
+            vals, cnts = np.unique(sk[:, j], return_counts=True)
+            for v, c in zip(vals.tolist(), cnts.tolist()):
+                counts[int(v)] = counts.get(int(v), 0) + int(c)
+            if len(counts) > 8192:
+                # bound the per-slot tally on very long campaigns: keep
+                # the hottest half, deterministically (count desc, value
+                # asc) — pruning is a pure function of the counter state,
+                # so an interrupted+resumed campaign prunes identically
+                keep = sorted(counts.items(),
+                              key=lambda kv: (-kv[1], kv[0]))[:4096]
+                self._slot_counts[j] = dict(keep)
+
+    def admit_foreign(self, entry: dict) -> bool:
+        """Merge one entry harvested by ANOTHER worker (service/store.py
+        scan): admitted only when its coverage key is new here, keeping
+        its foreign id and admission energy. Returns True on admission.
+        The merge is lock-free by construction — ids are namespaced per
+        worker and entries are immutable once written, so merging is
+        order-independent set union keyed by sched_hash."""
+        h = int(entry["hash"])
+        if h in self._seen:
+            return False
+        self._seen.add(h)
+        if entry.get("crash_code", 0):
+            self.crash_codes.add(int(entry["crash_code"]))
+        self._insert(dict(entry))
+        return True
+
+    def _insert(self, entry: dict) -> None:
+        self._by_id[entry["id"]] = entry
+        if len(self.entries) < self.max_entries:
+            self.entries.append(entry)
+        else:                        # replace the coldest slot
+            j = int(np.argmin([e["energy"] for e in self.entries]))
+            del self._by_id[self.entries[j]["id"]]
+            if self.track_evictions:
+                self.evicted_unsynced.append(self.entries[j])
+            self.entries[j] = entry
 
     # ------------------------------------------------------------------
     def observe(self, knobs_batch, seeds, hashes_u64, crashed, codes,
@@ -76,11 +178,20 @@ class Corpus:
         new_crash_codes = []
         div_slot = None
         n_slots = 0
-        if sketches is not None and self.div_bonus > 0:
+        if sketches is not None:
             sk = np.asarray(sketches)
             if sk.ndim == 2 and sk.shape[1] > 0:
-                div_slot = first_divergence_slots(sk)
-                n_slots = sk.shape[1]
+                # fold into the CROSS-ROUND consensus counters first, then
+                # measure each lane against the updated campaign modal —
+                # round 1 of a fresh corpus reproduces the old per-round
+                # modal exactly; later rounds judge novelty against the
+                # whole campaign's history (and, via the durable store,
+                # prior segments and other workers)
+                self._fold_sketches(sk)
+                if self.div_bonus > 0:
+                    n_slots = sk.shape[1]
+                    div_slot = first_divergence_slots(
+                        sk, consensus=self.consensus_sketch())
         for e in self.entries:
             e["energy"] = max(0.05, e["energy"] * self.decay)
         for i in range(len(seeds)):
@@ -108,13 +219,7 @@ class Corpus:
                          round=int(round_no), div_slot=slot,
                          crash_code=int(codes[i]) if hit_crash else 0)
             self._next_id += 1
-            self._by_id[entry["id"]] = entry
-            if len(self.entries) < self.max_entries:
-                self.entries.append(entry)
-            else:                        # replace the coldest slot
-                j = int(np.argmin([e["energy"] for e in self.entries]))
-                del self._by_id[self.entries[j]["id"]]
-                self.entries[j] = entry
+            self._insert(entry)
             parent = self._by_id.get(int(parent_ids[i]))
             if parent is not None:
                 parent["energy"] = min(

@@ -21,6 +21,20 @@ its first full repro handle — (seed, knob vector) — because a mutated
 lane's behavior is NOT reproducible from the seed alone. `minimize=True`
 auto-shrinks each repro's fault rows through `harness.minimize`
 (batched ddmin, knob domain — no slot-layout verification gap).
+
+Durable campaigns (r11, `corpus_dir=`): the corpus, the cross-round
+consensus sketch, and every crash repro live in a `service.CorpusStore`
+directory, synced at round boundaries. A killed campaign resumes from
+its last sync and — because everything between syncs is re-derived from
+(restored rng state, restored corpus, deterministic seeds) — converges
+to exactly the run that was never killed. Crashed lanes are additionally
+deduped into causal-fingerprint buckets (service/buckets.py). The price
+of the durability contract is that the speculative pipeline is disabled
+(round r+1's parents must be scheduled AFTER round r's sync point, or
+the persisted rng state could not replay the schedule draw); campaign
+throughput instead comes from multiple worker processes sharing the dir
+(service/campaign.py — the Podracer split: many cheap actors, one
+durable store).
 """
 
 from __future__ import annotations
@@ -34,13 +48,22 @@ from ..parallel import stats
 from .corpus import Corpus
 from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
 
+# seed-space stride between workers sharing a corpus dir: worker w's round
+# r runs seeds [base + w*STRIDE + r*batch, ...) mod 2^32. Campaigns stay
+# collision-free while rounds*batch < STRIDE (2^26 ≈ 67M seeds per worker)
+# and worker_id < 64 per base_seed (the uint32 seed space holds 64
+# strides; the 2^23-worker ID namespace is a separate, wider contract —
+# shard bigger fleets across base_seeds).
+WORKER_SEED_STRIDE = 1 << 26
+
 
 def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
          dry_rounds: int = 3, base_seed: int = 0, chunk: int = 512,
          pipeline: bool = True, fused: bool = True, dup_slots: int = 2,
          havoc: int = 3, fresh_frac: float = 0.125, rng_seed: int = 0,
          observer=None, minimize: bool = False, corpus: Corpus | None = None,
-         div_bonus: float | None = None):
+         div_bonus: float | None = None, corpus_dir: str | None = None,
+         worker_id: int = 0, sync_every: int = 1):
     """Coverage-guided schedule fuzzing over `rt`'s dynamic fault knobs.
 
     Round 0 is a blind bootstrap (base knobs, fresh seeds — one explore()
@@ -61,6 +84,22 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     regardless, and None keeps the corpus's setting — the default 1.0
     for a fresh corpus, whatever a passed-in `corpus` was built with).
 
+    Durable-campaign args (corpus_dir is the switch):
+      corpus_dir   a service.CorpusStore directory (created on first
+                   use, signature-checked on reopen). `max_rounds`
+                   becomes the CAMPAIGN total: a resumed call runs only
+                   the remaining rounds and returns immediately once
+                   rounds_done >= max_rounds or the persisted dry count
+                   saturated. With corpus_dir set, `distinct_schedules`
+                   reports the campaign's cumulative coverage as seen by
+                   this worker (resumes and cross-worker merges fold in).
+      worker_id    this process's namespace: entry ids, seed space
+                   (WORKER_SEED_STRIDE apart), and state/log file names.
+                   Give every concurrent worker on one dir a distinct id.
+      sync_every   rounds between durability points (1 = every round).
+                   A SIGKILL loses at most the work since the last sync,
+                   and the resumed run re-derives it bit-identically.
+
     observer: obs.metrics.SweepObserver — `on_round` records of kind
     "fuzz_round" (explore's round schema + corpus_size/new_crash_codes),
     `on_done` with the final result; hooks ride the harvest the loop
@@ -78,6 +117,40 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
       minimized         {code: minimize_knobs info} when minimize=True
     """
     plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
+    op_hist = np.zeros(N_MUT_OPS, np.int64)
+    store = buckets = None
+    round_start = 0
+    dry = 0
+    wall_prior = 0.0
+    if corpus_dir is not None:
+        from ..service.buckets import CrashBuckets
+        from ..service.store import CorpusStore, store_signature
+        store = CorpusStore(corpus_dir,
+                            signature=store_signature(rt, plan))
+        buckets = CrashBuckets(store)
+        if corpus is None:
+            corpus = store.load_corpus(
+                plan, worker_id=worker_id, rng_seed=rng_seed,
+                fresh_frac=fresh_frac,
+                div_bonus=1.0 if div_bonus is None else div_bonus)
+        else:
+            if corpus.worker_id != worker_id:
+                # a mismatched namespace would persist a worker state
+                # whose entry order points at files sync never writes —
+                # an unresumable store; fail before touching the dir
+                raise ValueError(
+                    f"corpus.worker_id={corpus.worker_id} != "
+                    f"fuzz(worker_id={worker_id}): a durable campaign's "
+                    "corpus must mint ids in its worker's namespace "
+                    "(build it with Corpus(..., worker_id=) or let "
+                    "fuzz load it from the store)")
+            corpus.track_evictions = True
+        ws = store.load_worker_state(worker_id)
+        round_start = int(ws.get("rounds_done", 0))
+        dry = int(ws.get("dry", 0))
+        wall_prior = float(ws.get("wall_s", 0.0))
+        if ws.get("op_hist"):
+            op_hist[:] = np.asarray(ws["op_hist"], np.int64)
     if corpus is None:
         corpus = Corpus(plan, rng=np.random.default_rng(rng_seed),
                         fresh_frac=fresh_frac,
@@ -88,13 +161,16 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         # hash-only-vs-divergence comparison run through this arg
         corpus.div_bonus = float(div_bonus)
     master = jax.random.PRNGKey(np.uint32(rng_seed ^ 0x5EED5EED))
-    op_hist = np.zeros(N_MUT_OPS, np.int64)
 
     def launch(r):
         """Schedule + mutate + dispatch one round without blocking on
         results (run_fused and the knob kernels are all async)."""
-        seeds = np.arange(base_seed + r * batch,
-                          base_seed + (r + 1) * batch, dtype=np.uint32)
+        # explicit mod-2^32 arithmetic: a large worker_id/base_seed wraps
+        # deterministically on every numpy instead of overflowing arange
+        lane0 = (base_seed + worker_id * WORKER_SEED_STRIDE
+                 + r * batch) % (1 << 32)
+        seeds = (np.arange(batch, dtype=np.uint64)
+                 + np.uint64(lane0)).astype(np.uint32)
         if r == 0 or len(corpus) == 0:
             knobs_dev = {k: v for k, v in plan.base_batch(batch).items()}
             ids = np.full(batch, -1, np.int64)
@@ -125,22 +201,32 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             op_hist[:] += np.asarray(hist)
         return (seeds, ids, knobs_host, hashes,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
-                hist is not None, sketches)
+                hist is not None, sketches, state)
 
-    seen: set[int] = set()
+    # under a durable store, `seen` starts at the campaign's cumulative
+    # coverage (this worker's view) so dry-detection and the distinct
+    # count continue across resumes instead of restarting from zero
+    seen: set[int] = corpus.coverage_keys() if store is not None else set()
     crashes: dict[int, int] = {}
     repros: dict[int, dict] = {}
+    opened_buckets: list[str] = []
     n_crashed = 0
     new_per_round: list[int] = []
-    dry = 0
     rounds = 0
-    speculate = pipeline and fused    # chunked runs block per chunk anyway
+    # the speculative pipeline schedules round r+1's parents BEFORE round
+    # r's harvest; a durable campaign must schedule AFTER the sync point
+    # (or the persisted rng state couldn't replay the draw), so the store
+    # forces the serial loop — multi-worker campaigns restore the overlap
+    speculate = pipeline and fused and store is None
     t0 = time.perf_counter()
-    pending = launch(0) if max_rounds > 0 else None
-    for r in range(max_rounds):
+    pending = (launch(round_start)
+               if round_start < max_rounds and dry < dry_rounds else None)
+    for r in range(round_start, max_rounds):
+        if pending is None:
+            break
         nxt = (launch(r + 1) if speculate and r + 1 < max_rounds else None)
         (seeds, ids, knobs_host, hashes, crashed, codes,
-         mutated, sketches) = harvest(pending)
+         mutated, sketches, state) = harvest(pending)
         rounds += 1
         cstats = corpus.observe(knobs_host, seeds, hashes, crashed, codes,
                                 ids, r, sketches=sketches)
@@ -152,6 +238,25 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 kn = KnobPlan.lane(knobs_host, int(i))
                 repros[c] = dict(seed=int(seeds[i]), round=r, knobs=kn,
                                  script=plan.to_scenario(kn).describe())
+        if buckets is not None and crashed.any():
+            # dedup crashes into causal-fingerprint buckets: one
+            # representative lane per distinct crash code per round keeps
+            # the host-side explain work bounded (the chain walk is
+            # O(trace_cap) per lane; codes, not lanes, are the cheap
+            # first partition — the fingerprint then splits bugs sharing
+            # a code across rounds)
+            coded: set[int] = set()
+            for i in np.nonzero(crashed)[0]:
+                c = int(codes[i])
+                if c in coded:
+                    continue
+                coded.add(c)
+                key, opened = buckets.observe_lane(
+                    state, int(i), seed=int(seeds[i]),
+                    knobs=KnobPlan.lane(knobs_host, int(i)),
+                    round_no=r, worker_id=worker_id)
+                if opened:
+                    opened_buckets.append(key)
         n_crashed += int(crashed.sum())
         fresh = set(hashes.tolist()) - seen
         seen |= fresh
@@ -165,6 +270,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 corpus_size=cstats["size"],
                 new_crash_codes=cstats["new_crash_codes"],
                 dry_rounds=dry, wall_s=time.perf_counter() - t0)
+            if buckets is not None:
+                rec["buckets_opened"] = len(opened_buckets)
             if sketches is not None:
                 # divergence depth of this round's mutants (median
                 # first-divergence slot vs the consensus prefix): how
@@ -173,6 +280,15 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 rec["div_slot_p50"] = int(np.median(
                     stats.first_divergence_slots(sketches)))
             observer.on_round(rec)
+        if store is not None and (
+                (r + 1 - round_start) % sync_every == 0
+                or dry >= dry_rounds or r + 1 == max_rounds):
+            # the durability point: after observe/buckets, BEFORE the
+            # next round's schedule draw — a resume restores the rng
+            # state saved here and replays that draw identically
+            store.sync(corpus, worker_id, rounds_done=r + 1, dry=dry,
+                       op_hist=op_hist,
+                       wall_s=wall_prior + time.perf_counter() - t0)
         if dry >= dry_rounds:
             break
         pending = nxt if nxt is not None else (
@@ -191,6 +307,12 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         mutation_ops={OP_NAMES[i]: int(op_hist[i])
                       for i in range(N_MUT_OPS)},
     )
+    if store is not None:
+        result.update(
+            corpus_dir=store.dir,
+            rounds_done_total=round_start + rounds,
+            buckets_opened=opened_buckets,
+            buckets_total=len(store.bucket_keys()))
     if minimize and repros:
         from ..harness.minimize import minimize_knobs
         result["minimized"] = {}
@@ -202,6 +324,18 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 result["minimized"][c] = dict(info, knobs=minimal)
             except Exception as e:  # noqa: BLE001 - repro handle still stands
                 result["minimized"][c] = dict(error=f"{type(e).__name__}: {e}")
+        if buckets is not None:
+            # attach the shrunk fault script to the buckets this run
+            # opened (matched by crash code — the repro/minimize tables
+            # are code-keyed): the bucket's canonical (seed, knobs) repro
+            # stays untouched, the minimal script is reporting
+            for key in buckets.new_keys:
+                rec_b = store.load_bucket(key)
+                mini = result["minimized"].get(int(rec_b["crash_code"]))
+                if mini and "script" in mini:
+                    rec_b["minimized"] = {
+                        k: v for k, v in mini.items() if k != "knobs"}
+                    store.write_bucket(key, rec_b)
     if observer is not None:
         observer.on_done(dict(
             kind="done", distinct_total=len(seen),

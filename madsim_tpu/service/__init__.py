@@ -1,0 +1,25 @@
+"""Persistent fuzzing campaigns (r11): the service layer over search/.
+
+A campaign today survives its process: the corpus, the cross-round
+consensus sketch, and every crash repro serialize into a versioned
+corpus directory (`store.py`, the checkpoint contract of MIGRATION.md:
+schema version + structural signature, reject-on-mismatch); crashes
+dedup into causal-fingerprint buckets (`buckets.py`, one bug = one
+bucket across lanes, seeds, processes, and ring-wrap depths); and N
+worker processes share one dir lock-free (`campaign.py`/`worker.py`,
+merge-by-construction: namespaced immutable entries + atomic renames).
+
+See DESIGN.md §13 "Persistence discipline".
+"""
+
+from .buckets import CrashBuckets, merged_buckets
+from .campaign import (campaign_report, campaign_stats, replay_bucket,
+                       run_campaign, spawn_worker, worker_cmd)
+from .store import CorpusStore, StoreMismatch, store_signature
+
+__all__ = [
+    "CorpusStore", "StoreMismatch", "store_signature",
+    "CrashBuckets", "merged_buckets",
+    "run_campaign", "campaign_report", "campaign_stats", "spawn_worker",
+    "worker_cmd", "replay_bucket",
+]

@@ -1,0 +1,143 @@
+"""Crash dedup by causal fingerprint: one bug = one bucket.
+
+The raw crash code is too coarse a dedup key (every invariant trip in a
+model shares one code) and the raw (seed, lane) too fine (millions of
+lanes hit the same bug). The r10 lineage layer gives the right key: the
+`explain_crash` parent chain — WHAT sequence of events caused the crash,
+independent of which lane/seed/process observed it. `obs/causal.py
+causal_fingerprint` hashes that chain wrap-stably (deepest-common-suffix
+matching, so ring truncation at different points can't split a bug);
+this module keeps the durable bucket files in a `CorpusStore`:
+
+  buckets/<key>.json        the fingerprint record + chain summary + the
+                            kept repro handle (seed, round, worker)
+  buckets/<key>.npz         the repro's full knob vector — with the seed,
+                            the complete replay handle (a mutated lane is
+                            NOT reproducible from its seed alone)
+  buckets/<key>.trace.json  Perfetto export of the crash lane's ring
+                            (flow arrows = the causal chain, r10)
+  buckets.jsonl             one line per bucketed observation (telemetry)
+
+Cross-process dedup is mostly by construction: two workers that compute
+the same fingerprint race to `os.replace` the same file name — last
+writer wins with equivalent content. The residual race (two workers
+opening buckets for one bug truncated at DIFFERENT wrap depths in the
+same instant) is repaired at read time: `merged_buckets` folds
+suffix-matching buckets together, so campaign reports count bugs, not
+write races.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..obs.causal import (causal_fingerprint, code_fingerprint,
+                          explain_crash, fingerprints_match)
+from .store import CorpusStore
+
+
+class CrashBuckets:
+    """The write-side bucket index one worker holds over a store."""
+
+    def __init__(self, store: CorpusStore):
+        self.store = store
+        self._index: dict[str, dict] = {}
+        self.new_keys: list[str] = []   # buckets THIS worker opened
+
+    def refresh(self) -> None:
+        for key in self.store.bucket_keys():
+            if key not in self._index:
+                self._index[key] = self.store.load_bucket(key)
+
+    def _match(self, fp: dict) -> str | None:
+        if fp["key"] in self._index:
+            return fp["key"]
+        best = None
+        best_depth = -1
+        for key, rec in self._index.items():
+            if fingerprints_match(fp, rec["fingerprint"]) \
+                    and rec["fingerprint"]["depth"] > best_depth:
+                best, best_depth = key, rec["fingerprint"]["depth"]
+        return best
+
+    def observe(self, fp: dict, *, seed: int, knobs: dict | None,
+                round_no: int, worker_id: int, chain: list | None = None,
+                state=None, lane: int | None = None) -> tuple[str, bool]:
+        """Fold one crash observation in. Returns (bucket key, opened):
+        `opened` is True when this observation created a new bucket (and
+        wrote its repro + trace artifacts); an observation matching an
+        existing bucket only appends a telemetry line — the first repro
+        stays the bucket's canonical handle."""
+        self.refresh()
+        key = self._match(fp)
+        opened = key is None
+        if opened:
+            key = fp["key"]
+            rec = dict(
+                key=key, fingerprint=fp,
+                crash_code=fp["crash_code"], crash_node=fp["crash_node"],
+                chain=[{k: int(c[k]) for k in c} for c in (chain or [])],
+                repro=dict(seed=int(seed), round=int(round_no),
+                           worker_id=int(worker_id)),
+                created_at=time.time())
+            self.store.write_bucket(key, rec, knobs=knobs)
+            if state is not None and lane is not None:
+                from ..obs.trace import export_chrome_trace
+                export_chrome_trace(self.store.bucket_path(
+                    key, ".trace.json"), state=state, lane=int(lane))
+            self._index[key] = rec
+            self.new_keys.append(key)
+        self.store.append_bucket_log(dict(
+            kind="crash", bucket=key, fp_key=fp["key"],
+            crash_code=fp["crash_code"], seed=int(seed),
+            round=int(round_no), worker_id=int(worker_id),
+            opened=bool(opened)))
+        return key, opened
+
+    def observe_lane(self, state, lane: int, *, seed: int,
+                     knobs: dict | None, round_no: int,
+                     worker_id: int) -> tuple[str, bool]:
+        """Fingerprint one crashed lane straight off its ring. Falls back
+        to the code fingerprint when the build compiled lineage out
+        (cfg.trace_cap == 0) — coarser buckets, still deduped."""
+        try:
+            exp = explain_crash(state, lane)
+            fp = causal_fingerprint(exp)
+            chain = exp["chain"]
+        except ValueError:
+            code = int(np.asarray(state.crash_code).reshape(-1)[lane])
+            node = int(np.asarray(state.crash_node).reshape(-1)[lane])
+            fp, chain, state, lane = code_fingerprint(code, node), None, \
+                None, None
+        return self.observe(fp, seed=seed, knobs=knobs, round_no=round_no,
+                            worker_id=worker_id, chain=chain, state=state,
+                            lane=lane)
+
+
+def merged_buckets(store: CorpusStore) -> list[dict]:
+    """The read-side truth: all buckets, with suffix-matching ones folded
+    together (repairing the concurrent-open race and cross-ring-depth
+    splits). Deepest chain wins as canonical; observation counts come
+    from the telemetry log. Deterministic: candidates are processed in
+    (depth desc, key) order."""
+    recs = [store.load_bucket(k) for k in store.bucket_keys()]
+    recs.sort(key=lambda r: (-r["fingerprint"]["depth"], r["key"]))
+    merged: list[dict] = []
+    for rec in recs:
+        home = None
+        for m in merged:
+            if fingerprints_match(rec["fingerprint"], m["fingerprint"]):
+                home = m
+                break
+        if home is None:
+            merged.append(dict(rec, members=[rec["key"]], observations=0))
+        else:
+            home["members"].append(rec["key"])
+    by_member = {k: m for m in merged for k in m["members"]}
+    for line in store.bucket_log():
+        m = by_member.get(line.get("bucket"))
+        if m is not None:
+            m["observations"] += 1
+    return merged

@@ -1,0 +1,222 @@
+"""The multi-process campaign driver: many cheap workers, one durable store.
+
+The CI-farm shape madsim users actually run (ROADMAP "production
+traffic"): N worker processes fuzz the same runtime into one shared
+corpus directory. Each worker owns its id's namespace (entry ids, seed
+space, scheduler state), merges the others' coverage at its round syncs,
+and dedups crashes into the shared causal-fingerprint buckets — the
+Podracer split (PAPERS.md) of many actors over one store, where the
+determinism core makes every merge safe by construction.
+
+Workers are real OS processes (`python -m madsim_tpu.service.worker`),
+not threads: each gets its own jax runtime, and all of them share the
+r8 persistent compile cache, so only the first cold worker pays the
+trace+compile wall. The driver here spawns them, polls the corpus dir
+for campaign-level stats (kind="campaign" SweepObserver records:
+uptime, schedules/s, buckets), and renders the merged report. Killing
+a worker — SIGKILL included — loses at most its work since its last
+round sync; relaunching the same worker id resumes it exactly
+(search/fuzz.py durability contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from .buckets import merged_buckets
+from .store import CorpusStore
+
+
+def worker_cmd(corpus_dir: str, worker_id: int, factory: str, *,
+               factory_kwargs: dict | None = None, max_steps: int,
+               batch: int = 64, max_rounds: int = 4, chunk: int = 256,
+               dry_rounds: int | None = None, base_seed: int = 0,
+               sync_every: int = 1, minimize: bool = False,
+               python: str = sys.executable) -> list[str]:
+    """The argv for one campaign worker process. `factory` is a
+    "module:function" spec resolved in the worker (the runtime itself
+    is not picklable across processes — a factory is the contract)."""
+    cmd = [python, "-m", "madsim_tpu.service.worker",
+           "--corpus-dir", corpus_dir,
+           "--worker-id", str(worker_id),
+           "--factory", factory,
+           "--max-steps", str(max_steps),
+           "--batch", str(batch),
+           "--max-rounds", str(max_rounds),
+           "--chunk", str(chunk),
+           "--base-seed", str(base_seed),
+           "--sync-every", str(sync_every)]
+    if factory_kwargs:
+        cmd += ["--factory-kwargs", json.dumps(factory_kwargs)]
+    if dry_rounds is not None:
+        cmd += ["--dry-rounds", str(dry_rounds)]
+    if minimize:
+        cmd += ["--minimize"]
+    return cmd
+
+
+def spawn_worker(corpus_dir: str, worker_id: int, factory: str,
+                 env: dict | None = None, **kw) -> subprocess.Popen:
+    """Launch one worker detached from this process's jax runtime. `env`
+    REPLACES the child environment when given (callers that must unpin a
+    TPU platform need removals, not just overrides); default inherits.
+    All workers share the persistent compile cache via
+    JAX_COMPILATION_CACHE_DIR; stdout carries the worker's final result
+    as one JSON line."""
+    e = dict(env) if env is not None else dict(os.environ)
+    # workers share the campaign's compile cache by default (r8): the
+    # first cold worker compiles, the rest replay the executable
+    e.setdefault("JAX_COMPILATION_CACHE_DIR",
+                 os.path.join(os.path.abspath(corpus_dir), ".jax_cache"))
+    return subprocess.Popen(
+        worker_cmd(corpus_dir, worker_id, factory, **kw), env=e,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+
+
+def campaign_stats(corpus_dir: str, *, uptime_s: float = 0.0,
+                   workers: int = 0, workers_alive: int = 0,
+                   round_no: int = 0, store: CorpusStore | None = None
+                   ) -> dict:
+    """One campaign-level rollup record off the shared dir (cheap scan;
+    the poll loop's SweepObserver.on_round payload and the basis of the
+    final report). Pass a long-lived `store` when polling — its
+    immutable-entry hash cache keeps each poll O(new files). Wall time
+    is the max over workers' own accounts — workers run concurrently,
+    their walls overlap."""
+    if store is None:
+        store = CorpusStore(corpus_dir, create=False)
+    coverage = store.coverage_keys()
+    states = [store.load_worker_state(w) for w in store.worker_ids()]
+    wall = max([s.get("wall_s", 0.0) for s in states], default=0.0)
+    rounds_done = sum(s.get("rounds_done", 0) for s in states)
+    buckets = store.bucket_keys()
+    crash_obs = len(store.bucket_log())
+    return dict(
+        kind="campaign", round=round_no, uptime_s=round(uptime_s, 2),
+        workers=workers, workers_alive=workers_alive,
+        corpus_entries=len(store.entry_names()),
+        coverage_keys=len(coverage),
+        rounds_done=rounds_done,
+        buckets=len(buckets),
+        crash_observations=crash_obs,
+        schedules_per_sec=round(len(coverage) / wall, 2) if wall else 0.0,
+        buckets_per_min=round(60.0 * len(buckets) / wall, 3) if wall
+        else 0.0,
+        worker_wall_s=round(wall, 2))
+
+
+def run_campaign(factory: str, corpus_dir: str, *, workers: int = 2,
+                 max_steps: int, batch: int = 64, max_rounds: int = 4,
+                 chunk: int = 256, factory_kwargs: dict | None = None,
+                 base_seed: int = 0, sync_every: int = 1,
+                 minimize: bool = False, observer=None,
+                 env: dict | None = None, poll_s: float = 2.0,
+                 python: str = sys.executable) -> dict:
+    """Run one campaign segment: spawn `workers` processes on one corpus
+    dir, poll campaign stats while they run, and return the merged
+    report. Re-running with the same arguments RESUMES the campaign
+    (each worker picks up at its rounds_done) — an always-on service is
+    this call in a loop with a growing `max_rounds`."""
+    t0 = time.monotonic()
+    procs = {
+        w: spawn_worker(corpus_dir, w, factory,
+                        factory_kwargs=factory_kwargs, max_steps=max_steps,
+                        batch=batch, max_rounds=max_rounds, chunk=chunk,
+                        base_seed=base_seed, sync_every=sync_every,
+                        minimize=minimize, env=env, python=python)
+        for w in range(workers)}
+    results = {}
+    poll = 0
+    poll_store = None
+    try:
+        while any(p.poll() is None for p in procs.values()):
+            time.sleep(poll_s)
+            poll += 1
+            if observer is not None and os.path.exists(
+                    os.path.join(corpus_dir, "MANIFEST.json")):
+                if poll_store is None:
+                    poll_store = CorpusStore(corpus_dir, create=False)
+                alive = sum(p.poll() is None for p in procs.values())
+                observer.on_round(campaign_stats(
+                    corpus_dir, uptime_s=time.monotonic() - t0,
+                    workers=workers, workers_alive=alive, round_no=poll,
+                    store=poll_store))
+    except KeyboardInterrupt:
+        # graceful stop: SIGTERM the workers, let their round finish is
+        # not guaranteed — but the store contract means nothing past the
+        # last sync is lost, and the next run_campaign resumes
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        raise
+    for w, p in procs.items():
+        out, _ = p.communicate()
+        line = (out or "").strip().splitlines()
+        results[w] = dict(returncode=p.returncode,
+                          result=json.loads(line[-1]) if line else None)
+    return campaign_report(corpus_dir, uptime_s=time.monotonic() - t0,
+                           workers=workers, worker_results=results)
+
+
+def campaign_report(corpus_dir: str, uptime_s: float = 0.0,
+                    workers: int = 0, worker_results: dict | None = None
+                    ) -> dict:
+    """The merged truth of a campaign dir: coverage, per-worker rounds,
+    crash buckets AFTER the read-side suffix merge (so the count is
+    bugs, not bucket-open races)."""
+    store = CorpusStore(corpus_dir, create=False)
+    stats = campaign_stats(corpus_dir, uptime_s=uptime_s, workers=workers,
+                           store=store)
+    merged = merged_buckets(store)
+    per_worker = {
+        w: store.load_worker_state(w) for w in store.worker_ids()}
+    return dict(
+        stats,
+        buckets_merged=len(merged),
+        bucket_detail=[
+            dict(key=m["key"], crash_code=m["crash_code"],
+                 members=m["members"], observations=m["observations"],
+                 repro=m["repro"],
+                 minimized="minimized" in m)
+            for m in merged],
+        workers_detail={
+            w: dict(rounds_done=s.get("rounds_done", 0),
+                    corpus_entries=len(s.get("order", [])),
+                    wall_s=round(s.get("wall_s", 0.0), 2),
+                    dry=s.get("dry", 0))
+            for w, s in per_worker.items()},
+        worker_results=worker_results)
+
+
+def replay_bucket(rt, corpus_dir: str, key: str, max_steps: int,
+                  chunk: int = 256, dup_slots: int = 2):
+    """Re-run a bucket's kept repro — the durable analog of pasting a
+    madsim seed into a failing test. Returns (crashed, crash_code,
+    explain dict or None): the (seed, knobs) handle replays the exact
+    trajectory on any host with a structurally equal runtime — the
+    manifest signature guards that (a mismatched `rt`, or a different
+    `dup_slots` than the campaign fuzzed with, raises StoreMismatch
+    here instead of replaying knobs onto the wrong rows)."""
+    import numpy as np
+
+    from ..obs.causal import explain_crash
+    from ..search.mutate import KnobPlan
+    from .store import store_signature
+    plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
+    store = CorpusStore(corpus_dir, signature=store_signature(rt, plan),
+                        create=False)
+    seed, knobs = store.load_bucket_repro(key)
+    state = plan.apply(rt.init_batch(np.asarray([seed], np.uint32)),
+                       KnobPlan.stack([knobs]))
+    state = rt.run_fused(state, max_steps, chunk)
+    crashed = bool(np.asarray(state.crashed)[0])
+    code = int(np.asarray(state.crash_code)[0])
+    exp = None
+    if crashed and rt.cfg.trace_cap > 0:
+        exp = explain_crash(state, 0)
+    return crashed, code, exp

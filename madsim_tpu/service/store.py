@@ -1,0 +1,448 @@
+"""The durable corpus store: a fuzzing campaign that survives its process.
+
+madsim's determinism core makes distributed crash harvests mergeable BY
+CONSTRUCTION — a `(seed, knobs)` pair reproduces an entire execution, so
+two workers' corpora are just two sets of replayable handles keyed by
+coverage (PAPER.md). This module turns the r9 in-memory `search.Corpus`
+into that durable, mergeable artifact: a directory any number of worker
+processes share, written with the same versioned reject-on-mismatch
+contract as `runtime/checkpoint.py` and read back into a campaign that
+resumes exactly where it left off.
+
+Layout (one campaign = one directory):
+
+  MANIFEST.json           format + version + structural signature —
+                          validated on open, REJECTED on mismatch (the
+                          checkpoint contract: silently merging corpora
+                          from different structures would poison both)
+  entries/w<w>-<c>.npz    one admitted corpus entry per file, IMMUTABLE
+                          once renamed into place: knob arrays + coverage
+                          key (sched_hash) + admission metadata. The file
+                          name IS the namespaced entry id (worker w,
+                          counter c), so cross-process merge is lock-free
+                          set union — no two workers can mint the same
+                          name, and a scan is a dedup-by-construction
+                          merge (search/corpus.py `admit_foreign`)
+  state/w<w>.json         one worker's scheduler state: rounds done, rng
+                          state, live-entry order + CURRENT energies,
+                          cross-round consensus sketch counters — energy
+                          and rng are per-worker POLICY state; coverage
+                          (the entry files) is the shared campaign truth
+  buckets/<key>.json|.npz|.trace.json
+                          crash buckets (service/buckets.py): fingerprint
+                          record, minimal (seed, knobs) repro, Perfetto
+                          trace of the crash lane
+  buckets.jsonl           append-only observation log (one line per
+                          bucketed crash observation; the bucket DIR is
+                          the deduped truth, this is the rate telemetry)
+  logs/w<w>.jsonl         per-worker SweepObserver records (fuzz rounds)
+
+Atomicity: every file is written to a `.tmp-<pid>` sibling and
+`os.replace`d into place, so a SIGKILL at any instant leaves either the
+old file or the new one, never a torn read — loaders additionally skip
+tmp names outright. A kill mid-SYNC (some entry files renamed, the state
+json not yet) is repaired on resume: own-namespace entry files whose
+counter is at or past the state's `next_counter` are ignored (the
+interrupted round re-runs deterministically and rewrites them with
+identical bytes), so resume converges to exactly the uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from ..search.corpus import _ID_SHIFT, Corpus, split_entry_id
+
+CORPUS_FORMAT = "madsim-corpus"
+CORPUS_VERSION = 1
+
+_TMP_MARK = ".tmp-"
+
+
+class StoreMismatch(ValueError):
+    """Corpus dir was written by a different format version or a
+    structurally different runtime — resuming would corrupt both."""
+
+
+# ---------------------------------------------------------------------------
+# atomic write primitives (write-then-rename; the whole durability story)
+# ---------------------------------------------------------------------------
+
+def _atomic_bytes(path: str, data: bytes) -> None:
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path)
+                               + _TMP_MARK)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            # fsync data before the rename and the directory after it:
+            # SIGKILL-safety needs only the rename, but the durability
+            # claim covers power loss, where an unsynced rename can
+            # reach disk before the data blocks (zero-length "new" file)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        dfd = os.open(d or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _atomic_json(path: str, obj) -> None:
+    _atomic_bytes(path, (json.dumps(obj, indent=1) + "\n").encode())
+
+
+def _atomic_npz(path: str, arrays: dict) -> None:
+    import io
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    _atomic_bytes(path, buf.getvalue())
+
+
+def _is_tmp(name: str) -> bool:
+    return _TMP_MARK in name
+
+
+# ---------------------------------------------------------------------------
+# signature
+# ---------------------------------------------------------------------------
+
+def store_signature(rt, plan) -> list:
+    """The structural identity a corpus dir is bound to: the step
+    program's structural signature (compile domain, DESIGN §10) plus the
+    knob-vector schema (shapes/dtypes of everything an entry stores).
+    Dynamic knobs (time_limit, exact latencies, ...) deliberately do NOT
+    key the store — they ride inside entries, the same split that lets
+    one executable serve many configs."""
+    knobs = plan.base_knobs()
+    return [
+        "corpus-sig-v1",
+        list(rt.cfg.structural_signature()),
+        [int(plan.n_init), int(plan.R), int(plan.D), int(plan.N),
+         int(plan.payload_words), bool(plan.jitter_gate)],
+        [[k, list(np.asarray(v).shape), str(np.asarray(v).dtype)]
+         for k, v in sorted(knobs.items())],
+    ]
+
+
+def _norm(sig) -> str:
+    return json.dumps(sig, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+class CorpusStore:
+    def __init__(self, corpus_dir: str, signature=None, create: bool = True):
+        self.dir = os.path.abspath(corpus_dir)
+        self.entries_dir = os.path.join(self.dir, "entries")
+        self.state_dir = os.path.join(self.dir, "state")
+        self.buckets_dir = os.path.join(self.dir, "buckets")
+        self.logs_dir = os.path.join(self.dir, "logs")
+        manifest_path = os.path.join(self.dir, "MANIFEST.json")
+        if create:
+            for d in (self.entries_dir, self.state_dir, self.buckets_dir,
+                      self.logs_dir):
+                os.makedirs(d, exist_ok=True)
+        if os.path.exists(manifest_path):
+            with open(manifest_path) as f:
+                man = json.load(f)
+            if man.get("format") != CORPUS_FORMAT:
+                raise StoreMismatch(
+                    f"{self.dir} is not a corpus dir "
+                    f"(format={man.get('format')!r})")
+            if man.get("version") != CORPUS_VERSION:
+                raise StoreMismatch(
+                    f"corpus format version {man.get('version')} != "
+                    f"supported {CORPUS_VERSION} — refusing to merge "
+                    "across formats; start a fresh dir (or migrate)")
+            if signature is not None and _norm(man.get("signature")) \
+                    != _norm(json.loads(json.dumps(signature))):
+                raise StoreMismatch(
+                    "corpus dir was written by a structurally different "
+                    "runtime/knob-plan — entries would not be replayable "
+                    "here. Expected signature:\n  "
+                    f"{_norm(signature)}\nfound:\n  "
+                    f"{_norm(man.get('signature'))}")
+            self.signature = man.get("signature")
+        else:
+            if not create:
+                raise FileNotFoundError(f"no corpus at {self.dir}")
+            if signature is None:
+                raise ValueError("creating a corpus dir needs a signature "
+                                 "(store_signature(rt, plan))")
+            self.signature = json.loads(json.dumps(signature))
+            _atomic_json(manifest_path, dict(
+                format=CORPUS_FORMAT, version=CORPUS_VERSION,
+                signature=self.signature))
+        # filenames already folded into the live corpus (merge cursor)
+        self._scanned: set[str] = set()
+        # entry files are IMMUTABLE once renamed into place, so their
+        # coverage keys cache forever on a store handle — keeps the
+        # campaign driver's poll loop O(new entries), not O(corpus)
+        self._hash_cache: dict[str, int] = {}
+
+    # -- naming --------------------------------------------------------
+    @staticmethod
+    def _entry_name(eid: int) -> str:
+        w, c = split_entry_id(eid)
+        return f"w{w:04d}-{c:012d}.npz"
+
+    @staticmethod
+    def _parse_entry_name(name: str) -> int | None:
+        if not (name.startswith("w") and name.endswith(".npz")) \
+                or _is_tmp(name):
+            return None
+        try:
+            w, c = name[1:-4].split("-")
+            return (int(w) << _ID_SHIFT) | int(c)
+        except ValueError:
+            return None
+
+    def worker_state_path(self, worker_id: int) -> str:
+        return os.path.join(self.state_dir, f"w{worker_id:04d}.json")
+
+    def worker_log_path(self, worker_id: int) -> str:
+        return os.path.join(self.logs_dir, f"w{worker_id:04d}.jsonl")
+
+    # -- entries -------------------------------------------------------
+    def write_entry(self, entry: dict) -> None:
+        """Persist one corpus entry (immutable admission record). Safe to
+        re-run: a deterministic re-execution of an interrupted round
+        rewrites the same name with identical content."""
+        arrays = {f"knob_{k}": np.asarray(v)
+                  for k, v in entry["knobs"].items()}
+        arrays.update(
+            id=np.int64(entry["id"]),
+            hash=np.uint64(entry["hash"]),
+            seed=np.int64(entry["seed"]),
+            energy0=np.float64(entry["energy"]),
+            round=np.int64(entry["round"]),
+            div_slot=np.int64(-1 if entry.get("div_slot") is None
+                              else entry["div_slot"]),
+            crash_code=np.int64(entry.get("crash_code", 0)))
+        _atomic_npz(os.path.join(self.entries_dir,
+                                 self._entry_name(entry["id"])), arrays)
+
+    def load_entry(self, name: str) -> dict:
+        with np.load(os.path.join(self.entries_dir, name)) as z:
+            knobs = {k[5:]: np.array(z[k]) for k in z.files
+                     if k.startswith("knob_")}
+            div = int(z["div_slot"])
+            return dict(id=int(z["id"]), hash=int(z["hash"]),
+                        seed=int(z["seed"]), energy=float(z["energy0"]),
+                        round=int(z["round"]),
+                        div_slot=None if div < 0 else div,
+                        crash_code=int(z["crash_code"]), knobs=knobs)
+
+    def entry_names(self) -> list[str]:
+        try:
+            names = os.listdir(self.entries_dir)
+        except FileNotFoundError:
+            return []
+        return sorted(n for n in names
+                      if self._parse_entry_name(n) is not None)
+
+    # -- worker state --------------------------------------------------
+    def load_worker_state(self, worker_id: int) -> dict:
+        p = self.worker_state_path(worker_id)
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def write_worker_state(self, corpus: Corpus, worker_id: int,
+                           rounds_done: int, dry: int, op_hist,
+                           wall_s: float) -> None:
+        self._write_own_entries(corpus, worker_id)
+        _atomic_json(self.worker_state_path(worker_id), dict(
+            worker_id=int(worker_id),
+            rounds_done=int(rounds_done),
+            dry=int(dry),
+            wall_s=float(wall_s),
+            op_hist=[int(x) for x in np.asarray(op_hist)],
+            next_counter=split_entry_id(corpus._next_id)[1],
+            order=[[int(e["id"]), float(e["energy"])]
+                   for e in corpus.entries],
+            crash_codes=sorted(int(c) for c in corpus.crash_codes),
+            sketch_counts=(None if corpus._slot_counts is None else
+                           [sorted((int(v), int(c)) for v, c in s.items())
+                            for s in corpus._slot_counts]),
+            rng_state=corpus.rng.bit_generator.state))
+
+    def _write_own_entries(self, corpus: Corpus, worker_id: int) -> None:
+        """Write any of this worker's admissions not yet on disk (ids in
+        the worker's namespace whose file is new to this store handle) —
+        including entries admitted AND evicted since the last sync, whose
+        coverage keys must survive a resume."""
+        for e in list(corpus.entries) + corpus.evicted_unsynced:
+            if split_entry_id(e["id"])[0] != worker_id:
+                continue
+            name = self._entry_name(e["id"])
+            if name in self._scanned:
+                continue
+            self.write_entry(e)
+            self._scanned.add(name)
+        corpus.evicted_unsynced.clear()
+
+    # -- corpus load / merge -------------------------------------------
+    def load_corpus(self, plan, worker_id: int = 0, rng_seed: int = 0,
+                    **corpus_kwargs) -> Corpus:
+        """Rebuild this worker's corpus: its own scheduler state (entry
+        order, current energies, rng, consensus counters) from the state
+        json, its own coverage history from its entry files, and every
+        OTHER worker's entries merged in (`admit_foreign`). A fresh dir
+        returns a fresh corpus seeded with `rng_seed`."""
+        corpus = Corpus(plan, rng=np.random.default_rng(rng_seed),
+                        worker_id=worker_id, **corpus_kwargs)
+        corpus.track_evictions = True
+        ws = self.load_worker_state(worker_id)
+        order = ws.get("order", [])
+        if ws:
+            corpus.rng.bit_generator.state = ws["rng_state"]
+            corpus._next_id = ((worker_id << _ID_SHIFT)
+                               | int(ws["next_counter"]))
+            corpus.crash_codes = set(ws.get("crash_codes", []))
+            sk = ws.get("sketch_counts")
+            if sk is not None:
+                corpus._slot_counts = [
+                    {int(v): int(c) for v, c in slot} for slot in sk]
+            for eid, energy in order:
+                e = self.load_entry(self._entry_name(int(eid)))
+                e["energy"] = float(energy)
+                corpus._seen.add(e["hash"])
+                corpus._insert(e)
+        next_counter = int(ws.get("next_counter", 0))
+        in_order = {int(eid) for eid, _ in order}
+        for name in self.entry_names():
+            eid = self._parse_entry_name(name)
+            w, c = split_entry_id(eid)
+            if w == worker_id:
+                self._scanned.add(name)
+                if eid in in_order:
+                    continue        # already placed, in slot order
+                if c >= next_counter:
+                    # half-synced leftover of an interrupted round: the
+                    # re-run regenerates it bit-identically — loading it
+                    # now would fork the resumed corpus from the
+                    # uninterrupted one
+                    continue
+                # admitted before the sync point but evicted since: its
+                # coverage key must stay seen (eviction never forgets)
+                corpus._seen.add(self.load_entry(name)["hash"])
+            else:
+                self._scanned.add(name)
+                corpus.admit_foreign(self.load_entry(name))
+        return corpus
+
+    def merge_foreign(self, corpus: Corpus) -> int:
+        """Fold entries other workers persisted since the last scan into
+        the live corpus. Lock-free: entry files are immutable and
+        namespaced, dedup is by coverage key."""
+        admitted = 0
+        for name in self.entry_names():
+            if name in self._scanned:
+                continue
+            eid = self._parse_entry_name(name)
+            if split_entry_id(eid)[0] == corpus.worker_id:
+                continue            # own files are written, never merged
+            self._scanned.add(name)
+            if corpus.admit_foreign(self.load_entry(name)):
+                admitted += 1
+        return admitted
+
+    def sync(self, corpus: Corpus, worker_id: int, rounds_done: int,
+             dry: int, op_hist, wall_s: float) -> dict:
+        """One durability point: merge other workers' new entries, then
+        persist this worker's admissions and scheduler state. Called at
+        round boundaries (fuzz(..., sync_every=)); everything between two
+        syncs is re-derived deterministically on resume."""
+        merged = self.merge_foreign(corpus)
+        self.write_worker_state(corpus, worker_id, rounds_done, dry,
+                                op_hist, wall_s)
+        return dict(merged_foreign=merged)
+
+    # -- read-only reporting -------------------------------------------
+    def worker_ids(self) -> list[int]:
+        out = []
+        for n in sorted(os.listdir(self.state_dir)):
+            if n.startswith("w") and n.endswith(".json") \
+                    and not _is_tmp(n):
+                out.append(int(n[1:-5]))
+        return out
+
+    def coverage_keys(self) -> set[int]:
+        """The campaign's coverage frontier: every sched_hash any worker
+        ever admitted (entry files are immutable admission records, so
+        this is exact even across evictions; cached per file on this
+        handle for the same reason)."""
+        for n in self.entry_names():
+            if n not in self._hash_cache:
+                self._hash_cache[n] = self.load_entry(n)["hash"]
+        return set(self._hash_cache.values())
+
+    # -- crash buckets (plumbing for service/buckets.py) ---------------
+    def bucket_path(self, key: str, suffix: str = ".json") -> str:
+        return os.path.join(self.buckets_dir, key + suffix)
+
+    def bucket_keys(self) -> list[str]:
+        try:
+            names = os.listdir(self.buckets_dir)
+        except FileNotFoundError:
+            return []
+        return sorted(n[:-5] for n in names
+                      if n.endswith(".json")
+                      and not n.endswith(".trace.json")
+                      and not _is_tmp(n))
+
+    def write_bucket(self, key: str, record: dict,
+                     knobs: dict | None = None) -> None:
+        if knobs is not None:
+            _atomic_npz(self.bucket_path(key, ".npz"),
+                        {f"knob_{k}": np.asarray(v)
+                         for k, v in knobs.items()})
+        _atomic_json(self.bucket_path(key), record)
+
+    def load_bucket(self, key: str) -> dict:
+        with open(self.bucket_path(key)) as f:
+            return json.load(f)
+
+    def load_bucket_repro(self, key: str) -> tuple[int, dict]:
+        """(seed, knobs) — the full replay handle of a bucket's kept
+        repro (a mutated lane's behavior needs both)."""
+        rec = self.load_bucket(key)
+        p = self.bucket_path(key, ".npz")
+        with np.load(p) as z:
+            knobs = {k[5:]: np.array(z[k]) for k in z.files
+                     if k.startswith("knob_")}
+        return int(rec["repro"]["seed"]), knobs
+
+    def append_bucket_log(self, rec: dict) -> None:
+        # single-line O_APPEND writes are atomic on POSIX at this size;
+        # this is telemetry (rates), the bucket dir is the deduped truth
+        with open(os.path.join(self.dir, "buckets.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def bucket_log(self) -> list[dict]:
+        p = os.path.join(self.dir, "buckets.jsonl")
+        if not os.path.exists(p):
+            return []
+        out = []
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out

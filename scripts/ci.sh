@@ -47,6 +47,12 @@ case "$tier" in
     # explain itself (parent chain + Perfetto flow arrows), and the
     # divergence profile must come back from the on-device sketches
     python bench.py --causal-smoke
+    # persistent-campaign smoke: two concurrent worker processes must
+    # merge into one corpus dir with deduped causal-fingerprint crash
+    # buckets, a SIGKILLed campaign must resume to exactly the
+    # uninterrupted run, and a structurally different runtime must be
+    # rejected by the store's version/signature contract
+    python bench.py --campaign-smoke
     if [[ "${2:-}" == "--compile-smoke" ]]; then
       # shared step-program cache smoke: two structurally-equal configs
       # must cost exactly one retrace and stay bitwise-equal to a

@@ -27,9 +27,10 @@ from madsim_tpu import (NetConfig, Runtime, Scenario, SimConfig,
 from madsim_tpu.core import types as T
 from madsim_tpu.core.state import TRACE_FIELDS as _TRACE_FIELDS
 from madsim_tpu.models.pingpong import PingPong, state_spec
-from madsim_tpu.obs import (export_chrome_trace, happens_before,
-                            ring_records, sketch_divergence,
-                            to_chrome_events)
+from madsim_tpu.obs import (causal_fingerprint, code_fingerprint,
+                            export_chrome_trace, fingerprints_match,
+                            happens_before, ring_records,
+                            sketch_divergence, to_chrome_events)
 from madsim_tpu.parallel.stats import first_divergence_slots
 from madsim_tpu.search.corpus import Corpus
 from madsim_tpu.search.mutate import KnobPlan
@@ -204,6 +205,117 @@ class TestLineage:
                            & (recs["tag"] == T.OP_KILL))[0]
         assert kills.size, "injected kill never dispatched"
         assert (recs["parent"][kills] == -1).all()
+
+
+class TestCausalFingerprint:
+    """(r11) crash-dedup fingerprints over explain_crash chains: lane-
+    and wrap-invariant, matched by deepest common suffix so a chain
+    truncated at different ring-wrap points stays ONE bucket."""
+
+    def _exp(self, toks, code=301, node=2, truncated=False,
+             root_external=True, step0=0, now_scale=10):
+        chain = [dict(step=step0 + i, now=(step0 + i) * now_scale,
+                      kind=k, node=n, src=s, tag=t,
+                      parent=step0 + i - 1, lamport=i + 1)
+                 for i, (k, n, s, t) in enumerate(toks)]
+        return dict(chain=chain, truncated=truncated,
+                    root_external=root_external, crashed=True,
+                    crash_code=code, crash_node=node, lane=0, dropped=0)
+
+    TOKS = [(1, 0, 0, 5), (2, 1, 0, 7), (2, 0, 1, 7), (3, 1, 1, 2),
+            (2, 2, 1, 7)]
+
+    def test_lane_invariant(self):
+        # same causal content at different steps/times/lane: same key
+        a = causal_fingerprint(self._exp(self.TOKS))
+        b = causal_fingerprint(self._exp(self.TOKS, step0=500,
+                                         now_scale=77))
+        assert a["key"] == b["key"]
+
+    def test_content_sensitive(self):
+        a = causal_fingerprint(self._exp(self.TOKS))
+        other = [*self.TOKS[:-1], (3, 0, 1, 2)]   # different crash node
+        assert a["key"] != causal_fingerprint(self._exp(other))["key"]
+        assert a["key"] != causal_fingerprint(
+            self._exp(self.TOKS, code=302))["key"]
+
+    def test_wrap_points_do_not_split_buckets(self):
+        """The satellite contract: one bug truncated at DIFFERENT wrap
+        points matches via the deepest common suffix."""
+        full = causal_fingerprint(self._exp(self.TOKS))
+        cuts = [causal_fingerprint(self._exp(
+            self.TOKS[k:], truncated=True, root_external=False))
+            for k in (1, 2, 3)]
+        for cut in cuts:
+            assert fingerprints_match(full, cut)
+            assert fingerprints_match(cut, full)
+        for a in cuts:
+            for b in cuts:
+                assert fingerprints_match(a, b)
+
+    def test_different_bugs_do_not_merge(self):
+        a = causal_fingerprint(self._exp(self.TOKS))
+        # two COMPLETE chains of different length are different bugs
+        # even though one's tokens are the other's suffix
+        b = causal_fingerprint(self._exp(self.TOKS[1:]))
+        assert a["complete"] and b["complete"]
+        assert not fingerprints_match(a, b)
+        # a CUT chain longer than a complete chain cannot be it either
+        short_full = causal_fingerprint(self._exp(self.TOKS[3:]))
+        long_cut = causal_fingerprint(self._exp(
+            self.TOKS[1:], truncated=True, root_external=False))
+        assert not fingerprints_match(short_full, long_cut)
+        # ... nor a cut chain of EQUAL depth: a cut chain always hides
+        # at least one more record than it shows, so a same-bug cut
+        # observation is strictly shorter than the complete history
+        equal_cut = causal_fingerprint(self._exp(
+            self.TOKS[3:], truncated=True, root_external=False))
+        assert not fingerprints_match(short_full, equal_cut)
+        assert not fingerprints_match(equal_cut, short_full)
+        # and different suffix content never matches
+        other = [*self.TOKS[:-1], (3, 3, 1, 2)]
+        assert not fingerprints_match(a, causal_fingerprint(self._exp(
+            other, truncated=True, root_external=False)))
+
+    def test_depth_cap_bounds_resolution(self):
+        deep_a = [(1, 0, 0, 9)] * 4 + self.TOKS
+        deep_b = [(1, 1, 1, 3)] * 4 + self.TOKS
+        a = causal_fingerprint(self._exp(deep_a), depth=5)
+        b = causal_fingerprint(self._exp(deep_b), depth=5)
+        assert a["key"] == b["key"]       # differ only past the horizon
+        assert not a["complete"] and a["depth"] == 5
+
+    def test_code_fingerprint_fallback(self):
+        fp = code_fingerprint(301, 2)
+        assert fp["kind"] == "code" and fp["depth"] == 0
+        assert fingerprints_match(fp, code_fingerprint(301, 2))
+        assert not fingerprints_match(fp, code_fingerprint(302, 2))
+        # code fingerprints never suffix-match causal ones
+        assert not fingerprints_match(
+            fp, causal_fingerprint(self._exp(self.TOKS)))
+
+    def test_empty_chain_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            causal_fingerprint(dict(chain=[], truncated=False,
+                                    root_external=False, crash_code=1,
+                                    crash_node=0))
+
+    def test_ring_wrap_stability_on_real_rings(self):
+        """Ground the synthetic contract in the engine: the same
+        trajectory recorded through a 4-slot ring (wrapped, truncated
+        chain) and a 128-slot ring (full chain) fingerprints into the
+        same bucket."""
+        seeds = np.arange(2, dtype=np.uint32)
+        small = _pingpong_rt(trace_cap=4, target=40)
+        big = _pingpong_rt(trace_cap=128, target=40)
+        ss = small.run_fused(small.init_batch(seeds), 256, 64)
+        sb = big.run_fused(big.init_batch(seeds), 256, 64)
+        for lane in range(2):
+            es, eb = explain_crash(ss, lane), explain_crash(sb, lane)
+            assert len(es["chain"]) <= len(eb["chain"])
+            fs = causal_fingerprint(es)
+            fb = causal_fingerprint(eb)
+            assert fingerprints_match(fs, fb), (lane, fs, fb)
 
 
 class TestSketch:
