@@ -707,6 +707,33 @@ def _make_crashrich_runtime(kind="wal_kv", trace_cap=0, sketch_slots=0):
                               scenario=sc, cfg=cfg)
 
 
+def _make_racy_runtime(trace_cap=256, sketch_slots=0):
+    """The RACE-rich flagship mutant for --analyze-smoke /
+    tests/test_analyze.py (one canonical definition, same convention as
+    the crashrich/saturating workloads): the crash-rich wal_kv matrix
+    (sync_wal=False under server kill/restart) with FIXED send latency.
+    Randomized latency spreads message arrivals across distinct ticks,
+    so the scheduler rarely faces a tie and the PCT nudge has nothing
+    to commute; pinning min==max makes concurrent client requests land
+    on the server at the SAME virtual instant — exactly the unordered
+    same-node dispatch pairs analyze/races.py hunts, in a workload
+    whose outcome (which unsynced write is lost) genuinely depends on
+    their order."""
+    from madsim_tpu import NetConfig, Scenario, SimConfig, ms, sec
+    from madsim_tpu.models.wal_kv import make_wal_kv_runtime
+    sc = Scenario()
+    for t in range(6):
+        sc.at(ms(150) + ms(250) * t).kill(0)
+        sc.at(ms(210) + ms(250) * t).restart(0)
+    cfg = SimConfig(n_nodes=3, event_capacity=256, payload_words=8,
+                    time_limit=sec(10), trace_cap=trace_cap,
+                    sketch_slots=sketch_slots,
+                    net=NetConfig(send_latency_min=ms(2),
+                                  send_latency_max=ms(2)))
+    return make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
+                               sync_wal=False, scenario=sc, cfg=cfg)
+
+
 def _search_ab_mode():
     """--mode search_ab: coverage-guided fuzzer vs blind explore() at
     EQUAL device-dispatch budget (same rounds x batch x max_steps), on
@@ -1445,6 +1472,151 @@ def _causal_smoke_mode():
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
+def _detsan_ab_mode():
+    """--mode detsan_ab: determinism-sanitizer overhead A/B at B=512
+    (harness/simtest.detsan_check vs one plain sweep; the ISSUE-8 /
+    DESIGN §14 contract is <= ~2x wall — the sanitizer is two full
+    sweeps through ONE shared executable plus a host-side leaf diff,
+    and both sweeps are dispatched before either is forced, so any
+    backend-side overlap lands below 2x). Interleaved min-of-reps, same
+    protocol as obs_ab; writes BENCH_detsan_ab_<platform>.json."""
+    _preflight_or_cpu("--detsan-ab")
+    import jax
+    from madsim_tpu.harness.simtest import detsan_check
+    platform = jax.devices()[0].platform
+    B, steps, chunk, reps = 512, 2048, 256, 5
+    rt = _make_light_runtime(n_nodes=2)
+    seeds = np.arange(B)
+    # warmup: compiles the one fused program both sides share
+    jax.block_until_ready(
+        rt.run_fused(rt.init_batch(seeds), steps, chunk).now)
+    best = {"run": float("inf"), "detsan": float("inf")}
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        final = rt.run_fused(rt.init_batch(seeds), steps, chunk)
+        jax.block_until_ready(final.now)
+        best["run"] = min(best["run"], time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        # raises DetSanFailure on any diff — a flagged clean runtime
+        # fails the bench loudly rather than publishing a wrong number
+        rep = detsan_check(rt, seeds, steps, chunk, fused=True)
+        best["detsan"] = min(best["detsan"], time.perf_counter() - t0)
+    overhead = best["detsan"] / best["run"]
+    out = {
+        "metric": "detsan_ab", "platform": platform, "batch": B,
+        "steps": steps, "chunk": chunk, "reps": reps,
+        "wall_run_s": round(best["run"], 4),
+        "wall_detsan_s": round(best["detsan"], 4),
+        "overhead_detsan": round(overhead, 3),
+        "vs_double_run": round(best["detsan"] / (2 * best["run"]), 3),
+        "leaves_compared": rep["leaves"],
+        "note": ("detsan = identity sweep + permuted-lane sweep (one "
+                 "shared executable, both dispatched before either is "
+                 "forced) + leaf-for-leaf host diff; overhead_detsan is "
+                 "wall vs ONE plain fused sweep — the <=2x sanitizer "
+                 "contract of DESIGN §14; vs_double_run isolates the "
+                 "diff+dispatch overhead above the two sweeps "
+                 "themselves (1.0 = free)"),
+    }
+    print(f"--detsan-ab: run {best['run']:.3f}s detsan "
+          f"{best['detsan']:.3f}s overhead {overhead:.2f}x",
+          file=sys.stderr)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_detsan_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _analyze_smoke_mode():
+    """--analyze-smoke: seconds-scale DetSan self-test for CI (wired
+    into scripts/ci.sh fast):
+
+      1. the lint gate: a planted-hazard source must trip every AST
+         rule (positive control — a silently toothless linter passes
+         any repo), and the repo-wide gate over madsim_tpu/ + examples/
+         must be CLEAN (the `python -m madsim_tpu.analyze` contract);
+      2. a confirmed-race roundtrip on the race-rich wal_kv mutant:
+         candidates from the happens-before rings, forced-commute
+         confirmation via the PCT nudge, a (seed, knobs, nudge) repro
+         that REPLAYS to the confirming lane's exact fingerprint, and
+         bucket dedup (a rescan must not open new buckets);
+      3. a detsan double-run over a clean runtime must pass, and the
+         leaf differ must catch a planted single-lane perturbation.
+
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import tempfile
+    from madsim_tpu.analyze.lint import active, lint_paths, lint_source
+    from madsim_tpu.analyze.races import replay_race, scan_races
+    from madsim_tpu.harness.simtest import detsan_check, diff_states
+    from madsim_tpu.search.mutate import KnobPlan
+    from madsim_tpu.service.buckets import CrashBuckets
+    from madsim_tpu.service.store import CorpusStore, store_signature
+    t0 = time.perf_counter()
+
+    # 1. lint: positive control, then the repo gate
+    planted = (
+        "import time, random\n"
+        "import numpy as np\n"
+        "from madsim_tpu.core.api import Program\n"
+        "class Bad(Program):\n"
+        "    def on_timer(self, ctx, tag, payload):\n"
+        "        t = time.time()\n"
+        "        r = np.random.rand()\n"
+        "        for x in {1, 2}: pass\n"
+        "        import jax\n"
+        "        jax.pure_callback(int, None)\n")
+    rules = {f.rule for f in active(lint_source(planted, "planted.py"))}
+    assert {"host-time", "host-random", "unordered-iter",
+            "host-callback"} <= rules, rules
+    here = os.path.dirname(os.path.abspath(__file__))
+    gate = active(lint_paths([os.path.join(here, "madsim_tpu"),
+                              os.path.join(here, "examples")]))
+    assert not gate, "repo lint gate dirty:\n" + "\n".join(
+        f.format() for f in gate)
+
+    # 2. race roundtrip on the canonical race-rich mutant
+    rt = _make_racy_runtime(trace_cap=256)
+    plan = KnobPlan.from_runtime(rt)
+    seeds = np.arange(32, dtype=np.uint32)
+    with tempfile.TemporaryDirectory() as d:
+        store = CorpusStore(d, signature=store_signature(rt, plan))
+        buckets = CrashBuckets(store)
+        res = scan_races(rt, seeds, 20_000, buckets=buckets,
+                         max_confirm=4)
+        assert res["confirmed"], f"no confirmed race: {res}"
+        conf = res["confirmed"][0]
+        rep = replay_race(rt, conf["repro"])
+        assert rep["fingerprint"] == conf["diff"]["fingerprint"][1], \
+            "(seed, knobs, nudge) repro did not replay"
+        n_buckets = len(store.bucket_keys())
+        res2 = scan_races(rt, seeds, 20_000, buckets=buckets,
+                          max_confirm=4)
+        assert len(store.bucket_keys()) == n_buckets, \
+            "rescan split one race into new buckets"
+        repro_rec = store.load_bucket(res["bucket_keys"][0])["repro"]
+        assert "nudge" in repro_rec, repro_rec
+
+    # 3. detsan: clean pass + planted-diff catch
+    rt2 = _make_light_runtime(n_nodes=4, loss=0.05)
+    drep = detsan_check(rt2, np.arange(32), 512, 128)
+    assert drep["ok"], drep
+    a = rt2.run_fused(rt2.init_batch(np.arange(8)), 256, 64)
+    b = a.replace(now=a.now.at[3].add(1))       # the planted violation
+    diffs = diff_states(a, b, align=np.arange(8))
+    assert diffs and diffs[0]["lanes"] == [3], diffs
+    print(json.dumps({
+        "metric": "analyze_smoke", "platform": "cpu", "ok": True,
+        "lint_rules_tripped": sorted(rules),
+        "race_candidates": res["candidates"],
+        "races_confirmed": len(res["confirmed"]),
+        "race_nudge": conf["nudge"],
+        "buckets": n_buckets,
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
 def _fused_smoke_mode():
     """--fused-smoke: seconds-scale fused-runner self-test for CI (wired
     into scripts/ci.sh): tiny shapes through run_fused + the chunked
@@ -1698,11 +1870,17 @@ def main():
                  "--obs-ab", "--obs-smoke", "--compile-ab",
                  "--compile-smoke", "--search-ab", "--search-smoke",
                  "--causal-ab", "--causal-smoke", "--campaign",
-                 "--campaign-smoke"}
+                 "--campaign-smoke", "--analyze-smoke", "--detsan-ab"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
         sys.argv.append(flag)
+    if "--analyze-smoke" in sys.argv:
+        _analyze_smoke_mode()
+        return
+    if "--detsan-ab" in sys.argv:
+        _detsan_ab_mode()
+        return
     if "--campaign-smoke" in sys.argv:
         _campaign_smoke_mode()
         return

@@ -26,6 +26,7 @@ from .core.types import (
     sec,
 )
 from .core.extension import Extension
+from .analyze import (confirm_race, find_races, lint_runtime, scan_races)
 from .harness.determinism import find_divergence
 from .obs import (
     JsonlObserver,
@@ -36,7 +37,8 @@ from .obs import (
     ring_records,
 )
 from .harness.minimize import minimize_scenario
-from .harness.simtest import SimFailure, run_seeds, simtest
+from .harness.simtest import (DetSanFailure, SimFailure, detsan_check,
+                              run_seeds, simtest)
 from .parallel.explore import explore
 from .parallel.stats import (divergence_profile, schedule_representatives,
                              summarize)
@@ -60,4 +62,6 @@ __all__ = [
     "export_chrome_trace", "explain_crash", "divergence_profile",
     "CorpusStore", "run_campaign", "campaign_report", "merged_buckets",
     "replay_bucket",
+    "lint_runtime", "find_races", "confirm_race", "scan_races",
+    "detsan_check", "DetSanFailure",
 ]

@@ -1,0 +1,36 @@
+"""Static + dynamic analysis over the determinism discipline (DESIGN §14).
+
+madsim's one guarantee — one seed reproduces one execution — holds only
+while user code stays inside the discipline DESIGN §4 spells out: traced
+bodies draw randomness from the engine's key stream, capture only values
+the signature can freeze, and never reach for host state. Nothing
+enforced that until r12; this package does, at three depths:
+
+  lint.py    STATIC: AST + closure inspection over the traced callables
+             (Program handlers, invariant/halt_when closures, Extension
+             hooks) — host clocks, host RNG, unordered-set iteration,
+             host callbacks, mutable captures, and captures the compile
+             signature can only freeze to identity tokens. Run it as
+             `python -m madsim_tpu.analyze [paths...]` (the repo gate)
+             or at construction with `Runtime(..., lint=True)`.
+  races.py   DYNAMIC, POST-HOC: walk the r10 happens-before rings for
+             unordered same-instant dispatch pairs at one node, then
+             CONFIRM each candidate by forcing the commuted tie-break
+             order with the r9 PCT nudge in fresh lanes and diffing
+             fingerprints — confirmed races carry a (seed, knobs, nudge)
+             repro and bucket like crashes (service/buckets.py).
+  harness/simtest.py detsan=True   DYNAMIC, ONLINE: every seed batch
+             runs twice under permuted lane placement and is diffed
+             leaf-for-leaf — the net for whatever the static pass
+             cannot see.
+"""
+
+from .lint import (DeterminismLintError, Finding, lint_callable,
+                   lint_paths, lint_runtime, lint_source)
+from .races import confirm_race, find_races, scan_races
+
+__all__ = [
+    "Finding", "DeterminismLintError", "lint_source", "lint_callable",
+    "lint_runtime", "lint_paths",
+    "find_races", "confirm_race", "scan_races",
+]

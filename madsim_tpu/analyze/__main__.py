@@ -1,0 +1,51 @@
+"""The repo determinism-lint gate: `python -m madsim_tpu.analyze [paths]`.
+
+With no arguments, lints the installed `madsim_tpu` package tree and an
+`examples/` directory next to it (i.e. the repo layout) — the whole
+surface where traced callables live. Exit status 0 = clean (suppressed
+findings are reported but do not fail); 1 = active findings; 2 = usage.
+
+  python -m madsim_tpu.analyze               # repo gate
+  python -m madsim_tpu.analyze models/x.py   # one file
+  python -m madsim_tpu.analyze -q dir/       # counts only
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from .lint import RULES, active, lint_paths
+
+
+def _default_paths() -> list[str]:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg)
+    paths = [pkg]
+    examples = os.path.join(repo, "examples")
+    if os.path.isdir(examples):
+        paths.append(examples)
+    return paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    quiet = "-q" in argv
+    argv = [a for a in argv if a != "-q"]
+    if any(a.startswith("-") for a in argv):
+        print(__doc__, file=sys.stderr)
+        return 2
+    paths = argv or _default_paths()
+    findings = lint_paths(paths)
+    bad = active(findings)
+    if not quiet:
+        for f in findings:
+            print(f.format())
+    n_sup = sum(1 for f in findings if f.suppressed)
+    print(f"detsan lint: {len(bad)} active finding(s), {n_sup} suppressed "
+          f"({len(RULES) - 1} rules over {', '.join(paths)})")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

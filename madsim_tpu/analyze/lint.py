@@ -1,0 +1,559 @@
+"""DetSan lint: static determinism hazards in traced callables.
+
+The determinism contract (DESIGN §4) is a *discipline*, not a property
+the engine can enforce at runtime: a Program handler that calls
+`time.time()` or `np.random.rand()` executes that call ONCE, at trace
+time, and bakes the value into the compiled program — the run still
+replays bit-identically, but rebuilding the Runtime (or losing the
+compile-cache entry) silently changes behavior, and the printed
+`MADSIM_TEST_SEED=` repro line stops reproducing. This linter finds
+those hazards where they are cheapest to find: in the source, before
+anything runs.
+
+What counts as a TRACED SCOPE (the only place the rules apply — host
+driver code may use clocks and RNG freely):
+  - methods of classes deriving from `Program` or `Extension` (by base
+    name), including functions nested in them;
+  - callables passed as `invariant=` / `halt_when=` (lambdas, named
+    module functions, and the closures returned by factories called in
+    those positions — `invariant=raft_invariant(5, 32)` marks
+    `raft_invariant`'s inner def);
+  - nested defs of any module function whose name contains
+    "invariant" (the factory idiom every flagship model uses, reachable
+    even when the construction site lives in another file).
+
+The rule table (each finding carries its rule id):
+
+  host-time        wall-clock reads (`time.time`, `datetime.now`, ...)
+  host-random      host RNG (`random.*`, `np.random.*`, `os.urandom`,
+                   `uuid.uuid1/4`, `secrets.*`) — draw from `ctx.rand*`
+                   / the engine key stream instead
+  unordered-iter   iterating a set/frozenset/`vars()`/`__dict__` —
+                   Python sets iterate in hash order, which PYTHONHASHSEED
+                   re-randomizes per interpreter; trace once and the
+                   baked emission ORDER differs between processes
+  host-callback    `jax.pure_callback` / `io_callback` / `debug.callback`
+                   inside a traced body — host code running mid-step is
+                   outside the replay domain entirely
+  mutable-capture  a closure cell / default / Program attribute holding
+                   a list/dict/set/bytearray: the signature freezes its
+                   VALUE at construction, so mutating it later changes
+                   the traced program invisibly (DESIGN §10 freezes
+                   semantics at construction; this flags the footgun)
+  sig-degrade      a capture `compile/signature.py` can only freeze to a
+                   per-object identity token — the step-program cache
+                   silently falls back to per-instance entries (no
+                   cross-Runtime sharing) and warm-cache repros stop
+                   matching; the finding names the offending cell
+
+Suppression: append `# detsan: ok(<rule>)` (or `ok(*)`) to the flagged
+line, or put it alone on the line directly above. Suppressed findings
+stay in the report (marked) but do not fail the gate.
+
+Entry points: `lint_source` (one blob), `lint_paths` (the repo gate —
+`python -m madsim_tpu.analyze`), `lint_callable` / `lint_program` /
+`lint_runtime` (live objects: AST of their source PLUS the closure
+inspection only runtime has — `Runtime(..., lint=True)` runs the last
+one at construction).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import functools
+import inspect
+import os
+import re
+import textwrap
+from typing import Any, Callable, Iterable
+
+# ---------------------------------------------------------------------------
+# rule table
+# ---------------------------------------------------------------------------
+
+RULES = {
+    "host-time": "wall-clock read in a traced body (baked in at trace time)",
+    "host-random": "host RNG in a traced body (use ctx.rand*/engine keys)",
+    "unordered-iter": "iteration over a set/vars()/__dict__ (hash order "
+                      "varies per interpreter)",
+    "host-callback": "host callback compiled into a traced body",
+    "mutable-capture": "mutable container captured by a traced callable "
+                       "(frozen by value at construction; later mutation "
+                       "is invisible)",
+    "sig-degrade": "capture freezes to an identity token — compile cache "
+                   "degrades to per-instance (no cross-Runtime sharing)",
+    "parse-error": "file could not be parsed",
+}
+
+_TIME_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.perf_counter_ns", "time.process_time",
+    "time.sleep", "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+_RANDOM_CALLS = {"os.urandom", "os.getrandom", "uuid.uuid1", "uuid.uuid4"}
+_RANDOM_PREFIXES = ("random.", "numpy.random.", "secrets.")
+_CALLBACK_CALLS = {
+    "jax.pure_callback", "jax.experimental.io_callback",
+    "jax.debug.callback", "jax.experimental.host_callback.call",
+}
+_UNORDERED_BUILTINS = {"set", "frozenset", "vars"}
+_MUTABLE_TYPES = (list, dict, set, bytearray)
+
+_SUPPRESS_RE = re.compile(r"#\s*detsan:\s*ok\(\s*([a-z*\-]+)\s*\)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    where: str          # qualname-ish label of the traced scope
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        mark = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.where}: "
+                f"{self.message}{mark}")
+
+
+class DeterminismLintError(AssertionError):
+    """Raised by `Runtime(..., lint=True)` on active (unsuppressed)
+    findings."""
+
+    def __init__(self, findings: list[Finding]):
+        self.findings = findings
+        lines = "\n  ".join(f.format() for f in findings)
+        super().__init__(
+            f"determinism lint: {len(findings)} active finding(s)\n  "
+            f"{lines}\n(suppress intentional ones with "
+            f"`# detsan: ok(<rule>)` on the flagged line)")
+
+
+def active(findings: Iterable[Finding]) -> list[Finding]:
+    """The findings that fail the gate (suppressions filtered out)."""
+    return [f for f in findings if not f.suppressed and f.rule in RULES]
+
+
+# ---------------------------------------------------------------------------
+# dotted-name resolution through the module's import aliases
+# ---------------------------------------------------------------------------
+
+
+def _import_aliases(tree: ast.Module) -> dict[str, str]:
+    """alias -> dotted path, from the module's import statements (walked
+    everywhere: function-local imports are common in this repo)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            # relative imports keep their dots ("from . import raft as R"
+            # -> ".raft"): the traced-scope heuristics use the prefix to
+            # recognize in-package model imports
+            prefix = "." * node.level + (node.module or "")
+            for a in node.names:
+                dotted = f"{prefix}.{a.name}" if prefix else a.name
+                aliases[a.asname or a.name] = dotted
+    return aliases
+
+
+def _dotted(expr: ast.AST, aliases: dict[str, str]) -> str | None:
+    """`np.random.default_rng` -> "numpy.random.default_rng" (root name
+    rewritten through the alias table); None when the chain does not
+    bottom out in a plain name."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+# ---------------------------------------------------------------------------
+# traced-scope discovery
+# ---------------------------------------------------------------------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _base_label(b: ast.AST) -> str:
+    if isinstance(b, ast.Name):
+        return b.id
+    if isinstance(b, ast.Attribute):
+        return b.attr
+    return ""
+
+
+def _nested_funcs(fn: ast.AST):
+    for n in ast.walk(fn):
+        if isinstance(n, _FUNC_NODES + (ast.Lambda,)) and n is not fn:
+            yield n
+
+
+def _traced_roots(tree: ast.Module,
+                  path: str = "<string>") -> list[tuple[ast.AST, str]]:
+    """(node, label) pairs for every scope the rules apply to."""
+    roots: list[tuple[ast.AST, str]] = []
+    seen: set[int] = set()
+    aliases = _import_aliases(tree)
+
+    def add(node, label):
+        if id(node) not in seen:
+            seen.add(id(node))
+            roots.append((node, label))
+
+    # program-ish classes: direct Program/Extension bases, transitive
+    # in-module subclasses, and cross-module model inheritance
+    # (`class CfgRaft(R.Raft)` — the base resolves into a models module,
+    # or into a relative sibling of a file that itself lives in models/;
+    # `Runtime(..., lint=True)` resolves the real MRO, this is the best
+    # a single-file static pass can do)
+    in_models = f"{os.sep}models{os.sep}" in path
+
+    def programish(b: ast.AST, prog_classes: set[str]) -> bool:
+        lbl = _base_label(b)
+        if not lbl:
+            return False
+        if lbl in prog_classes or lbl.endswith(("Program", "Extension")):
+            return True
+        if isinstance(b, ast.Attribute):
+            root = _dotted(b.value, aliases) or ""
+        else:
+            root = aliases.get(lbl, "")
+        return ".models." in root or "models." in root \
+            or (in_models and root.startswith("."))
+
+    classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+    prog_classes: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for c in classes:
+            if c.name not in prog_classes and \
+                    any(programish(b, prog_classes) for b in c.bases):
+                prog_classes.add(c.name)
+                changed = True
+    for node in classes:
+        if node.name in prog_classes:
+            for n in node.body:
+                if isinstance(n, _FUNC_NODES):
+                    add(n, f"{node.name}.{n.name}")
+
+    mod_defs = {n.name: n for n in tree.body if isinstance(n, _FUNC_NODES)}
+
+    def mark_value(v: ast.AST, slot: str):
+        if isinstance(v, ast.Lambda):
+            add(v, f"<lambda {slot}>")
+        elif isinstance(v, ast.Name) and v.id in mod_defs:
+            add(mod_defs[v.id], v.id)
+        elif isinstance(v, ast.Call):
+            f = v.func
+            if isinstance(f, ast.Name) and f.id in mod_defs:
+                for n in _nested_funcs(mod_defs[f.id]):
+                    add(n, f"{f.id}.{getattr(n, 'name', '<lambda>')}")
+        elif isinstance(v, ast.BoolOp):
+            for x in v.values:
+                mark_value(x, slot)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in ("invariant", "halt_when"):
+                    mark_value(kw.value, kw.arg)
+
+    # the factory idiom, reachable from other files: raft_kv constructs
+    # with `R.raft_invariant(...)` — raft.py itself must still lint the
+    # closure, so any module function named like an invariant factory
+    # has its nested defs treated as traced
+    for name, fn in mod_defs.items():
+        if "invariant" in name:
+            for n in _nested_funcs(fn):
+                add(n, f"{name}.{getattr(n, 'name', '<lambda>')}")
+    return roots
+
+
+# ---------------------------------------------------------------------------
+# the AST rules
+# ---------------------------------------------------------------------------
+
+
+def _is_unordered_iterable(expr: ast.AST, aliases: dict[str, str]) -> bool:
+    if isinstance(expr, ast.Set):
+        return True
+    if isinstance(expr, ast.Attribute) and expr.attr == "__dict__":
+        return True
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id in _UNORDERED_BUILTINS:
+            return True
+        # .keys()/.values()/.items() over one of the above
+        if isinstance(f, ast.Attribute) and f.attr in ("keys", "values",
+                                                       "items"):
+            return _is_unordered_iterable(f.value, aliases)
+    return False
+
+
+def _check_call(dotted: str | None) -> tuple[str, str] | None:
+    if dotted is None:
+        return None
+    if dotted in _TIME_CALLS:
+        return "host-time", f"`{dotted}()` reads the host clock"
+    if dotted in _RANDOM_CALLS or dotted.startswith(_RANDOM_PREFIXES):
+        return "host-random", f"`{dotted}()` draws host randomness"
+    if dotted in _CALLBACK_CALLS:
+        return "host-callback", f"`{dotted}` runs host code mid-step"
+    return None
+
+
+def _scan_scope(root: ast.AST, label: str, aliases: dict[str, str],
+                path: str, out: list[Finding], line_off: int = 0) -> None:
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            hit = _check_call(_dotted(node.func, aliases))
+            if hit:
+                out.append(Finding(hit[0], path, node.lineno + line_off,
+                                   label, hit[1]))
+        iters: list[ast.AST] = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iters.extend(g.iter for g in node.generators)
+        for it in iters:
+            if _is_unordered_iterable(it, aliases):
+                out.append(Finding(
+                    "unordered-iter", path, it.lineno + line_off, label,
+                    "iteration order is hash order — sort it (or iterate "
+                    "a tuple/dict, which keep insertion order)"))
+
+
+def _apply_suppressions(findings: list[Finding],
+                        src_lines: list[str], line_off: int = 0) -> None:
+    """Mark findings covered by a `# detsan: ok(rule)` on the flagged
+    line or alone on the line above (lines are 1-based file lines;
+    `line_off` maps them back into `src_lines`)."""
+
+    def rules_at(i: int) -> set[str]:
+        if 0 <= i < len(src_lines):
+            return set(_SUPPRESS_RE.findall(src_lines[i]))
+        return set()
+
+    for f in findings:
+        i = f.line - line_off - 1
+        ok = rules_at(i) | rules_at(i - 1)
+        if f.rule in ok or "*" in ok:
+            f.suppressed = True
+
+
+# ---------------------------------------------------------------------------
+# entry points — source side
+# ---------------------------------------------------------------------------
+
+
+def lint_source(src: str, path: str = "<string>") -> list[Finding]:
+    """Lint one source blob: find its traced scopes, apply the AST rules,
+    honor suppressions. Returns ALL findings (suppressed ones marked);
+    `active()` filters to the gate-failing subset."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 0, "<module>",
+                        str(e.msg))]
+    aliases = _import_aliases(tree)
+    findings: list[Finding] = []
+    for root, label in _traced_roots(tree, path):
+        _scan_scope(root, label, aliases, path, findings)
+    # one scope can be reached twice (class rule + kwarg rule): dedupe
+    uniq: dict[tuple, Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line, f.message), f)
+    findings = sorted(uniq.values(), key=lambda f: (f.path, f.line, f.rule))
+    _apply_suppressions(findings, src.splitlines())
+    return findings
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    """The repo gate: lint every .py under `paths` (files or dirs)."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _, names in os.walk(p):
+                files.extend(os.path.join(dirpath, n)
+                             for n in sorted(names) if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    findings: list[Finding] = []
+    for f in sorted(set(files)):
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            findings.append(Finding("parse-error", f, 0, "<file>", str(e)))
+            continue
+        findings.extend(lint_source(src, f))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points — live-object side (closure inspection needs runtime)
+# ---------------------------------------------------------------------------
+
+
+def _contains_unique(frozen: Any) -> bool:
+    from ..compile.signature import contains_identity_token
+    return contains_identity_token(frozen)
+
+
+@functools.lru_cache(maxsize=256)
+def _module_aliases(mod_file: str | None) -> dict[str, str]:
+    """The import-alias table of a module FILE, cached: lint_runtime
+    lints every handler of every program, most defined in one module —
+    re-parsing it per callable would be pure repeated work."""
+    if not mod_file:
+        return {}
+    try:
+        with open(mod_file, encoding="utf-8") as f:
+            return _import_aliases(ast.parse(f.read()))
+    except (OSError, SyntaxError, ValueError):
+        return {}
+
+
+def _callable_src(fn) -> tuple[str | None, str, int]:
+    """(dedented source, file path, first line - 1) — best effort; live
+    callables without retrievable source (REPL lambdas) skip the AST
+    half and keep the closure checks."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        path = inspect.getsourcefile(fn) or "<live>"
+        code = getattr(fn, "__code__", None)
+        line0 = (code.co_firstlineno if code is not None
+                 else inspect.getsourcelines(fn)[1]) - 1
+        ast.parse(src)              # a lambda's clipped line may not parse
+        return src, path, line0
+    except (OSError, TypeError, SyntaxError):
+        return None, "<live>", 0
+
+
+def lint_callable(fn: Callable, name: str | None = None) -> list[Finding]:
+    """Lint one live traced callable: the AST rules over its source (the
+    WHOLE body is a traced scope here — the caller vouched that `fn` is
+    traced) plus the closure checks source alone cannot do."""
+    if isinstance(fn, property):
+        return []
+    raw = fn.__func__ if inspect.ismethod(fn) else fn
+    label = name or getattr(raw, "__qualname__", repr(fn))
+    findings: list[Finding] = []
+    src, path, line0 = _callable_src(raw)
+    src_lines: list[str] = []
+    def_line = getattr(getattr(raw, "__code__", None), "co_firstlineno", 0)
+    if src is not None:
+        tree = ast.parse(src)
+        aliases = _import_aliases(tree)
+        # module-level imports are invisible from the clipped source;
+        # resolve the function's own global names through its module
+        mod = inspect.getmodule(raw)
+        if mod is not None:
+            aliases = {**_module_aliases(getattr(mod, "__file__", None)),
+                       **aliases}
+        for node in tree.body:
+            _scan_scope(node, label, aliases, path, findings,
+                        line_off=line0)
+        src_lines = src.splitlines()
+    code = getattr(raw, "__code__", None)
+    closure = getattr(raw, "__closure__", None) or ()
+    names = code.co_freevars if code is not None else ()
+    from ..compile.signature import freeze
+    for cname, cell in zip(names, closure):
+        try:
+            val = cell.cell_contents
+        except ValueError:          # empty cell
+            continue
+        if isinstance(val, _MUTABLE_TYPES):
+            findings.append(Finding(
+                "mutable-capture", path, def_line, label,
+                f"closure cell `{cname}` holds a "
+                f"{type(val).__name__} — its value is frozen into the "
+                f"compile signature at construction; mutate it and the "
+                f"traced program silently diverges"))
+        if _contains_unique(freeze(val)):
+            findings.append(Finding(
+                "sig-degrade", path, def_line, label,
+                f"closure cell `{cname}` "
+                f"({type(val).__name__}) freezes to an identity token — "
+                f"this callable opts its Runtime out of cross-instance "
+                f"program sharing (compile/signature.py)"))
+    for dflt in (getattr(raw, "__defaults__", None) or ()):
+        if isinstance(dflt, _MUTABLE_TYPES):
+            findings.append(Finding(
+                "mutable-capture", path, def_line, label,
+                f"mutable default ({type(dflt).__name__}) on a traced "
+                f"callable — frozen by value at construction"))
+    _apply_suppressions(findings, src_lines, line_off=line0)
+    return findings
+
+
+def lint_program(prog, name: str | None = None) -> list[Finding]:
+    """Lint one Program (or Extension) instance: its handler methods via
+    `lint_callable`, plus its instance attributes (they are captured
+    parameters — the signature freezes them by value)."""
+    label = name or type(prog).__name__
+    findings: list[Finding] = []
+    for m in ("init", "on_message", "on_timer", "on_op", "on_event",
+              "reset_node"):
+        fn = getattr(prog, m, None)
+        base = getattr(type(prog).__mro__[-2], m, None)  # Program/Extension
+        if fn is None or getattr(fn, "__func__", fn) is base:
+            continue                # inherited no-op: nothing to lint
+        findings.extend(lint_callable(fn, name=f"{label}.{m}"))
+    from ..compile.signature import freeze
+    src, path, line0 = _callable_src(type(prog))
+    def_line = line0 + 1 if src else 0
+    attr_findings: list[Finding] = []
+    for aname, val in sorted(vars(prog).items()):
+        if aname.startswith("_madsim"):
+            continue
+        if isinstance(val, _MUTABLE_TYPES):
+            attr_findings.append(Finding(
+                "mutable-capture", path, def_line, label,
+                f"attribute `{aname}` holds a {type(val).__name__} — "
+                f"frozen by value into the compile signature at "
+                f"construction"))
+        if _contains_unique(freeze(val)):
+            attr_findings.append(Finding(
+                "sig-degrade", path, def_line, label,
+                f"attribute `{aname}` ({type(val).__name__}) freezes to "
+                f"an identity token — no cross-Runtime program sharing"))
+    if src is not None:
+        # suppressions against THIS class's source apply only to the
+        # attribute findings minted above — handler findings already
+        # carry their own source's suppressions (lint_callable), and a
+        # handler inherited from another FILE would misindex here
+        _apply_suppressions(attr_findings, src.splitlines(),
+                            line_off=line0)
+    return findings + attr_findings
+
+
+def lint_runtime(rt) -> list[Finding]:
+    """Everything a Runtime construction bakes into its trace: programs,
+    invariant, halt_when, extensions. `Runtime(..., lint=True)` raises
+    `DeterminismLintError` when `active()` of this is non-empty."""
+    findings: list[Finding] = []
+    for i, prog in enumerate(rt.programs):
+        findings.extend(lint_program(
+            prog, name=f"programs[{i}]:{type(prog).__name__}"))
+    if rt.invariant is not None:
+        findings.extend(lint_callable(rt.invariant, name="invariant"))
+    halt = getattr(rt, "_halt_when", None)
+    if halt is not None:
+        findings.extend(lint_callable(halt, name="halt_when"))
+    for e in rt.extensions:
+        findings.extend(lint_program(e, name=f"extension:{e.name}"))
+    return findings

@@ -1,0 +1,356 @@
+"""Schedule-race detection over the happens-before rings, with
+forced-commute confirmation.
+
+The r10 lineage layer records, for every dispatched event, the dispatch
+that ENQUEUED it (`parent`) — the happens-before edges of the
+trajectory. The scheduler's only free decision (core/step.py) is the
+tie-break among earliest-deadline events, so a *schedule race* has a
+precise shape here: two dispatches at the SAME node, at the SAME
+virtual instant, neither an HB-ancestor of the other — their order was
+tie-break luck, and both mutate the node's state. That is the batched
+analog of a FastTrack vector-clock race: same location, conflicting
+accesses, unordered by happens-before.
+
+Classic race detectors stop at "unordered + conflicting" and drown in
+benign reports. Here suspicion is CONFIRMED by construction: the r9
+PCT priority nudge (`SimState.prio_nudge`) replaces the uniform
+tie-break with a deterministic policy without recompiling, so the
+commuted order is just another lane — `confirm_race` replays the
+(seed, knobs) handle under a batch of nudge policies, finds lanes
+where the pair actually dispatched in the flipped order, and diffs
+final-state fingerprints and crash verdicts against the observed
+order. Only a pair whose commutation CHANGES the outcome is reported;
+a pair that commutes to a bit-identical state is recorded as benign.
+A confirmed race carries a complete, deterministic repro — the
+(seed, knobs, nudge) triple — and buckets like a crash
+(service/buckets.py, `obs.causal.race_fingerprint`).
+
+Everything here is host-side numpy over ring reads plus ordinary
+batched replays; nothing touches the step program.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from ..obs.causal import race_fingerprint
+from ..obs.rings import ring_records, sampled_lanes
+
+_TOKEN_KEYS = ("kind", "node", "src", "tag")
+
+
+def _tokens(recs: dict) -> list[tuple[int, ...]]:
+    cols = [np.asarray(recs[k]) for k in _TOKEN_KEYS]
+    return [tuple(int(c[i]) for c in cols)
+            for i in range(len(cols[0]))]
+
+
+def find_races(state, lane: int = 0, max_pairs: int = 16,
+               window: int = 4) -> list[dict]:
+    """Candidate race pairs from one lane's ring: dispatches (a, b) at
+    the same node and the same virtual `now`, with a NOT an HB-ancestor
+    of b (walking `parent` edges), within `window` dispatches of each
+    other at that node. Pairs whose two events carry identical
+    (kind, node, src, tag) tokens are skipped — commuting them is
+    unobservable, so they can never confirm.
+
+    Returns candidate dicts {lane, node, now, a, b} with `a`/`b` the
+    full ring records (a dispatched first). Raises (via ring_records)
+    when the ring is compiled out or the lane unsampled; pre-r10 states
+    without lineage columns raise ValueError.
+    """
+    recs = ring_records(state, lane)
+    if "parent" not in recs:
+        raise ValueError("no lineage columns: state predates r10 or was "
+                         "built without cfg.trace_cap > 0")
+    steps = np.asarray(recs["step"])
+    nows = np.asarray(recs["now"])
+    parents = np.asarray(recs["parent"])
+    n = len(steps)
+    by_step = {int(s): i for i, s in enumerate(steps)}
+    toks = _tokens(recs)
+
+    def ancestors(i: int) -> set[int]:
+        out: set[int] = set()
+        j = i
+        while True:
+            p = int(parents[j])
+            if p < 0 or p not in by_step:
+                return out          # external root or wrap-truncated
+            j = by_step[p]
+            out.add(j)
+
+    by_node: dict[int, list[int]] = defaultdict(list)
+    for i in range(n):
+        by_node[int(recs["node"][i])].append(i)
+
+    out: list[dict] = []
+    for node, idxs in sorted(by_node.items()):
+        for k in range(len(idxs) - 1):
+            a = idxs[k]
+            anc_cache: dict[int, set[int]] = {}
+            for m in range(k + 1, min(k + 1 + window, len(idxs))):
+                b = idxs[m]
+                if int(nows[a]) != int(nows[b]):
+                    break           # nows are monotonic: no later tie
+                if toks[a] == toks[b]:
+                    continue        # commuting identical tokens is moot
+                anc = anc_cache.get(b)
+                if anc is None:
+                    anc = anc_cache[b] = ancestors(b)
+                if a in anc:
+                    continue        # causally ordered: not a race
+                rec = {key: {f: int(recs[f][i]) for f in
+                             ("step", "now", "kind", "node", "src", "tag",
+                              "parent", "lamport")}
+                       for key, i in (("a", a), ("b", b))}
+                out.append(dict(lane=int(lane), node=int(node),
+                                now=int(nows[a]), **rec))
+                if len(out) >= max_pairs:
+                    return out
+    return out
+
+
+def _pair_order(recs: dict, cand: dict) -> bool | None:
+    """Did this lane dispatch the candidate's `a` token before its `b`
+    token at a shared instant? True = observed order, False = commuted,
+    None = the pair never co-occurred at one instant in this lane's
+    surviving window. Uses the FIRST co-occurrence: nows are monotonic,
+    so records sharing an instant are contiguous in ring order."""
+    toks = _tokens(recs)
+    nows = np.asarray(recs["now"])
+    ta = tuple(cand["a"][k] for k in _TOKEN_KEYS)
+    tb = tuple(cand["b"][k] for k in _TOKEN_KEYS)
+    i = 0
+    n = len(toks)
+    while i < n:
+        j = i
+        while j < n and nows[j] == nows[i]:
+            j += 1
+        grp = toks[i:j]
+        if ta in grp and tb in grp:
+            return grp.index(ta) < grp.index(tb)
+        i = j
+    return None
+
+
+def confirm_race(rt, seed: int, cand: dict, *, knobs: dict | None = None,
+                 plan=None, nudges=None, max_steps: int = 20_000,
+                 chunk: int = 512, base_nudge: int | None = None) -> dict:
+    """Force the commuted order of one candidate pair and diff outcomes.
+
+    Replays `seed` (with `knobs` applied when the candidate came from a
+    fuzz mutant — a mutated lane is not reproducible from its seed
+    alone) as one batch: lane 0 under `base_nudge` (the observed
+    tie-break policy) and one lane per candidate nudge policy. For each
+    nudged lane whose ring shows the pair in the FLIPPED order, the
+    final-state fingerprint and crash verdict are diffed against lane
+    0. The verdicts:
+
+      confirmed   some commuting lane's outcome differs AND the
+                  divergence survives replay verification — both the
+                  baseline and the commuted handle replay as single
+                  lanes until self-consistent (`replay_race`), so
+                  `diff` carries the VERIFIED values the
+                  (seed, knobs, nudge) handle actually reproduces
+      benign      at least one lane commuted the pair and EVERY one of
+                  them finished bit-identical to the baseline — the
+                  operations commute
+      inconclusive  no nudge in the sweep flipped the pair (or the pair
+                  left the ring window); widen `nudges`
+
+    Returns {status, nudge, repro, baseline, diff, commuted, swept}.
+    """
+    if base_nudge is None:
+        # the baseline must replay the OBSERVED schedule: a fuzz mutant
+        # may carry its own tie-break policy in the knob vector, and
+        # diffing commuted lanes against any other policy would compare
+        # against a run the candidate never came from
+        base_nudge = (int(np.asarray(knobs["prio_nudge"]))
+                      if knobs is not None else 0)
+    if nudges is None:
+        nudges = np.arange(1, 25, dtype=np.int32)
+    nudges = np.asarray(nudges, np.int32).reshape(-1)
+    nudges = nudges[nudges != base_nudge]   # a baseline clone confirms nothing
+    all_n = np.concatenate([np.asarray([base_nudge], np.int32), nudges])
+    B = all_n.shape[0]
+    state = rt.init_batch(np.full(B, seed, np.uint32))
+    if knobs is not None:
+        from ..search.mutate import apply_repro_knobs
+        state, plan = apply_repro_knobs(rt, state, knobs, plan)
+    from ..search.pct import with_prio_nudge
+    state = with_prio_nudge(state, all_n)
+    state = rt.run_fused(state, max_steps, chunk)
+    fps = rt.fingerprints(state)
+    crashed = np.asarray(state.crashed)
+    codes = np.asarray(state.crash_code)
+    cnodes = np.asarray(state.crash_node)
+
+    def verdict(i):
+        return dict(crashed=bool(crashed[i]), crash_code=int(codes[i]),
+                    crash_node=int(cnodes[i]))
+
+    base = dict(order_observed=_pair_order(ring_records(state, 0), cand),
+                fingerprint=int(fps[0]), **verdict(0))
+    commuted: list[int] = []
+    hits: list[int] = []
+    for i in range(1, B):
+        if _pair_order(ring_records(state, i), cand) is False:
+            commuted.append(int(all_n[i]))
+            if int(fps[i]) != int(fps[0]):
+                hits.append(i)
+    out = dict(baseline=base, commuted=commuted,
+               swept=[int(x) for x in all_n[1:]],
+               candidate=cand)
+    # "confirmed" is a REPLAY claim, so verify it by replaying: the
+    # sweep batch is a screen, and a screen lane can be wrong — this
+    # jaxlib's first invocation of a fused executable deserialized from
+    # the persistent compile cache can return a corrupted result under
+    # concurrent load (reproduced with stock runners, never surviving a
+    # second invocation; ROADMAP r12). replay_race runs each handle
+    # until two consecutive invocations agree, so the diff reported
+    # here is the one the repro handle actually reproduces.
+    base_rep = None
+    for i in hits:
+        nudge = int(all_n[i])
+        if base_rep is None:
+            base_rep = replay_race(
+                rt, dict(seed=int(seed), knobs=knobs, nudge=base_nudge),
+                plan=plan, max_steps=max_steps, chunk=chunk)
+        repro = dict(seed=int(seed), knobs=knobs, nudge=nudge)
+        hit_rep = replay_race(rt, repro, plan=plan, max_steps=max_steps,
+                              chunk=chunk)
+        if hit_rep["fingerprint"] == base_rep["fingerprint"]:
+            continue            # the screen lane was the corrupted one
+        out.update(
+            status="confirmed", nudge=nudge, repro=repro,
+            diff=dict(fingerprint=(base_rep["fingerprint"],
+                                   hit_rep["fingerprint"]),
+                      baseline={k: base_rep[k] for k in
+                                ("crashed", "crash_code", "crash_node")},
+                      commuted={k: hit_rep[k] for k in
+                                ("crashed", "crash_code", "crash_node")}))
+        return out
+    if commuted:
+        out.update(status="benign", nudge=None, repro=None, diff=None)
+    else:
+        out.update(status="inconclusive", nudge=None, repro=None, diff=None)
+    return out
+
+
+def replay_race(rt, repro: dict, *, plan=None, max_steps: int = 20_000,
+                chunk: int = 512, verify: bool = True) -> dict:
+    """Replay a confirmed race's (seed, knobs, nudge) handle alone —
+    one lane — and return {fingerprint, crashed, crash_code,
+    crash_node}. Determinism (seed i in any batch == seed i alone,
+    DESIGN §4) makes this match the confirming lane bit-for-bit; the
+    tests hold that.
+
+    verify=True (default) runs the lane until two CONSECUTIVE
+    invocations agree and returns that fixed point. The engine is
+    deterministic, but this jaxlib's first invocation of a fused
+    executable deserialized from the persistent compile cache can
+    return a corrupted result under concurrent machine load (found by
+    this very check during r12 — the corruption is transient and never
+    survives a re-invocation; minimal repro in the ROADMAP r12 note).
+    An authoritative repro value must not depend on that coin flip.
+    Raises RuntimeError if three invocations yield three values — that
+    is real nondeterminism, not the known transient."""
+    from ..search.pct import with_prio_nudge
+
+    def once():
+        nonlocal plan
+        state = rt.init_batch(np.asarray([repro["seed"]], np.uint32))
+        knobs = repro.get("knobs")
+        if knobs is not None:
+            from ..search.mutate import apply_repro_knobs
+            state, plan = apply_repro_knobs(rt, state, knobs, plan)
+        state = with_prio_nudge(state,
+                                np.asarray([repro["nudge"]], np.int32))
+        state = rt.run_fused(state, max_steps, chunk)
+        return dict(fingerprint=int(rt.fingerprints(state)[0]),
+                    crashed=bool(np.asarray(state.crashed)[0]),
+                    crash_code=int(np.asarray(state.crash_code)[0]),
+                    crash_node=int(np.asarray(state.crash_node)[0]))
+
+    out = once()
+    if not verify:
+        return out
+    again = once()
+    if again == out:
+        return out
+    third = once()
+    if third != again:
+        raise RuntimeError(
+            f"race repro does not replay deterministically: three "
+            f"invocations gave {out['fingerprint']}, "
+            f"{again['fingerprint']}, {third['fingerprint']} — this is "
+            f"beyond the known first-invocation compile-cache transient")
+    return again
+
+
+def _dedupe_key(cand: dict) -> tuple:
+    ta = tuple(cand["a"][k] for k in _TOKEN_KEYS)
+    tb = tuple(cand["b"][k] for k in _TOKEN_KEYS)
+    return (cand["node"],) + tuple(sorted((ta, tb)))
+
+
+def scan_races(rt, seeds, max_steps: int = 20_000, chunk: int = 512,
+               *, knobs: dict | None = None, plan=None, lanes=None,
+               max_lanes: int = 4, max_confirm: int = 8, nudges=None,
+               buckets=None, worker_id: int = 0) -> dict:
+    """The batteries-included pass: run a seed batch with the ring on,
+    harvest candidate pairs from (by default) the crashed lanes — a
+    crash is where an order bug is worth the confirm budget — dedupe
+    them by token pair, and `confirm_race` each against its own lane's
+    (seed, knobs) handle.
+
+    `buckets` (a service.buckets.CrashBuckets) turns confirmed races
+    into first-class findings: each is observed under its
+    `race_fingerprint`, so two lanes/workers hitting one pair share one
+    bucket, with the (seed, knobs, nudge) repro as the bucket's handle.
+
+    Returns {candidates, confirmed, benign, inconclusive, lanes,
+    bucket_keys}. Requires cfg.trace_cap > 0.
+    """
+    if rt.cfg.trace_cap == 0:
+        raise ValueError("scan_races needs the flight-recorder ring: "
+                         "build the runtime with SimConfig(trace_cap > 0)")
+    seeds = np.asarray(seeds, np.uint32).reshape(-1)
+    state = rt.init_batch(seeds)
+    if knobs is not None:
+        from ..search.mutate import apply_repro_knobs
+        state, plan = apply_repro_knobs(rt, state, knobs, plan)
+    state = rt.run_fused(state, max_steps, chunk)
+    if lanes is None:
+        crashed = np.asarray(state.crashed)
+        lanes = np.nonzero(crashed)[0][:max_lanes]
+        if len(lanes) == 0:
+            lanes = sampled_lanes(state)[:max_lanes]
+    by_key: dict[tuple, dict] = {}
+    lane_list = [int(x) for x in lanes]
+    for lane in lane_list:
+        for cand in find_races(state, lane):
+            by_key.setdefault(_dedupe_key(cand), cand)
+    results = dict(candidates=len(by_key), confirmed=[], benign=0,
+                   inconclusive=0, lanes=lane_list, bucket_keys=[])
+    for cand in list(by_key.values())[:max_confirm]:
+        seed = int(seeds[cand["lane"]])
+        conf = confirm_race(rt, seed, cand, knobs=knobs, plan=plan,
+                            nudges=nudges, max_steps=max_steps, chunk=chunk)
+        if conf["status"] == "confirmed":
+            results["confirmed"].append(conf)
+            if buckets is not None:
+                key, _ = buckets.observe(
+                    race_fingerprint(cand, conf["diff"]),
+                    seed=seed, knobs=knobs, round_no=0,
+                    worker_id=worker_id, nudge=conf["nudge"],
+                    chain=[cand["a"], cand["b"]])
+                results["bucket_keys"].append(key)
+        elif conf["status"] == "benign":
+            results["benign"] += 1
+        else:
+            results["inconclusive"] += 1
+    return results

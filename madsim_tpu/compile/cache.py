@@ -38,6 +38,8 @@ class CompileLog:
         self.traces = collections.Counter()      # label -> retrace count
         self.events = collections.deque(maxlen=self.MAX_EVENTS)
         self.durations = collections.Counter()   # stage -> seconds
+        self.degrades: list[dict] = []           # signature degradations
+        self._degraded_keys: set = set()
         self._observers: list[Any] = []
         self._t0 = time.time()
 
@@ -47,6 +49,29 @@ class CompileLog:
             time.time() - self._t0, 3), **info)
         with self._lock:
             self.traces[label] += 1
+            self.events.append(rec)
+            observers = list(self._observers)
+        for o in observers:
+            o.on_compile(rec)
+
+    # -- signature degradations (compile/signature.py) --------------------
+    def note_degrade(self, owner: str, cell: str, detail: str = "") -> None:
+        """A closure capture froze to an identity token: `owner`'s cell
+        `cell` opted its Runtime out of cross-instance program sharing
+        (compile/signature.py module docstring). Before r12 this was a
+        SILENT cache degrade — cache misses were undiagnosable; now it
+        is an observer record (kind="compile",
+        label="signature_degrade") and a line in `summary()` — the
+        suite-end report scripts/ci.sh prints. De-duplicated per
+        (owner, cell): freeze() runs on every construction."""
+        rec = dict(kind="compile", label="signature_degrade",
+                   owner=owner, cell=cell, detail=detail,
+                   t=round(time.time() - self._t0, 3))
+        with self._lock:
+            if (owner, cell) in self._degraded_keys:
+                return
+            self._degraded_keys.add((owner, cell))
+            self.degrades.append(rec)
             self.events.append(rec)
             observers = list(self._observers)
         for o in observers:
@@ -83,6 +108,7 @@ class CompileLog:
                 traces_total=sum(self.traces.values()),
                 stage_secs={k: round(v, 3)
                             for k, v in self.durations.items()},
+                degrades=list(self.degrades),
             )
 
     def summary(self) -> str:
@@ -91,9 +117,17 @@ class CompileLog:
                  sorted(s["traces"].items(), key=lambda kv: -kv[1])]
         stages = " ".join(f"{k}={v:.1f}s"
                           for k, v in sorted(s["stage_secs"].items()))
+        deg = s["degrades"]
+        deg_s = ""
+        if deg:
+            who = ", ".join(f"{d['owner']}.{d['cell']}" for d in deg[:6])
+            deg_s = (f" | {len(deg)} signature degrade(s) — no cross-"
+                     f"Runtime sharing for: {who}"
+                     + (" …" if len(deg) > 6 else ""))
         return (f"compile log: {s['traces_total']} trace(s)"
                 + (f" [{', '.join(parts)}]" if parts else "")
                 + (f" | {stages}" if stages else "")
+                + deg_s
                 + f" | {PROGRAM_CACHE.describe()}")
 
 

@@ -90,6 +90,32 @@ def _unique_for(obj: Any):
         return _Unique()
 
 
+def contains_identity_token(frozen: Any) -> bool:
+    """Whether a frozen value carries an identity token somewhere — i.e.
+    freezing DEGRADED: the value is cache-sound but opts its Runtime out
+    of cross-instance program sharing. `analyze/lint.py` uses this for
+    its `sig-degrade` rule; `freeze` itself uses it to emit the
+    COMPILE_LOG warning for degraded closure cells."""
+    if isinstance(frozen, _Unique):
+        return True
+    if isinstance(frozen, (tuple, frozenset)):
+        return any(contains_identity_token(x) for x in frozen)
+    return False
+
+
+def _note_degrade(owner, cell: str, val: Any) -> None:
+    """Route one degraded capture to the compile log (observer record +
+    suite-end summary line). Best-effort: observability must never turn
+    a valid construction into an error."""
+    try:
+        from .cache import COMPILE_LOG
+        COMPILE_LOG.note_degrade(
+            getattr(owner, "__qualname__", repr(owner)), cell,
+            detail=type(val).__name__)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _global_names(code, _depth: int = 0) -> set:
     """Names a code object (and its nested lambdas/comprehensions) may
     resolve from module globals — co_names, walked through co_consts."""
@@ -163,15 +189,29 @@ def freeze(v: Any, _depth: int = 0, _seen: frozenset = frozenset()) -> Any:
     if isinstance(v, types.MethodType):
         return ("method", freeze(v.__func__, d, s), freeze(v.__self__, d, s))
     if isinstance(v, types.FunctionType):
-        cells = tuple(freeze(c.cell_contents, d, s)
-                      for c in (v.__closure__ or ()))
+        # a cell that freezes to an identity token is the silent-cache-
+        # degrade case: name it (qualname + cell) through COMPILE_LOG
+        # instead of letting the cache misses stay undiagnosable
+        cells_l = []
+        for cname, c in zip(v.__code__.co_freevars, v.__closure__ or ()):
+            fz = freeze(c.cell_contents, d, s)
+            if contains_identity_token(fz):
+                _note_degrade(v, cname, c.cell_contents)
+            cells_l.append(fz)
+        cells = tuple(cells_l)
         # referenced module globals are part of the function's behavior:
         # CPython compares code objects by VALUE, so byte-identical
         # source in two modules yields equal code objects even when the
         # globals they read differ — fold those bindings in like cells
         gnames = sorted(_global_names(v.__code__)
                         & v.__globals__.keys())
-        gvals = tuple((n, freeze(v.__globals__[n], d, s)) for n in gnames)
+        gvals = []
+        for n in gnames:
+            fz = freeze(v.__globals__[n], d, s)
+            if contains_identity_token(fz):
+                _note_degrade(v, f"global:{n}", v.__globals__[n])
+            gvals.append((n, fz))
+        gvals = tuple(gvals)
         return ("fn", v.__code__,
                 freeze(v.__defaults__, d, s),
                 freeze(v.__kwdefaults__, d, s),  # kw-only defaults bake

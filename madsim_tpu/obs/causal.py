@@ -188,6 +188,32 @@ def code_fingerprint(crash_code: int, crash_node: int) -> dict:
                 kind="code")
 
 
+def race_fingerprint(cand: dict, diff: dict | None = None) -> dict:
+    """Fingerprint a CONFIRMED schedule race (analyze/races.py) for
+    bucket dedup: the same token pair at the same node is the same
+    finding across lanes, seeds, nudges, and workers. The pair is
+    order-normalized (a race is symmetric in its two events — the
+    observed order is an artifact of which schedule was seen first)
+    and hashes only the events' wrap-stable identity tokens, never
+    step/now/lamport (`_chain_tokens` rationale).
+
+    Same schema as `causal_fingerprint` so `service/buckets.py` stores
+    and `merged_buckets` folds it unchanged; `kind="race"` matches by
+    key equality only (`fingerprints_match` treats non-causal kinds
+    that way). `crash_code`/`crash_node` carry the COMMUTED outcome's
+    verdict when `diff` is given (what the race flips the run into) —
+    0/-1 for races confirmed by fingerprint divergence alone."""
+    ta = tuple(int(cand["a"][k]) for k in ("kind", "node", "src", "tag"))
+    tb = tuple(int(cand["b"][k]) for k in ("kind", "node", "src", "tag"))
+    toks = sorted((ta, tb))
+    commuted = (diff or {}).get("commuted", {})
+    code = int(commuted.get("crash_code", 0))
+    node = int(commuted.get("crash_node", -1))
+    key = "race-" + _digest((int(cand["node"]),), toks, marker="race")
+    return dict(key=key, suffix_hashes=[], depth=2, complete=True,
+                crash_code=code, crash_node=node, kind="race")
+
+
 def fingerprints_match(a: dict, b: dict) -> bool:
     """Whether two fingerprints denote the same bug — the deepest-common-
     suffix rule. Equal keys always match. Otherwise two causal

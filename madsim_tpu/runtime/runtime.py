@@ -71,7 +71,8 @@ class Runtime:
                  persist: Any = None,
                  halt_when: Callable | None = None,
                  extensions: Sequence = (),
-                 share_programs: bool = True):
+                 share_programs: bool = True,
+                 lint: bool | str = False):
         self.cfg = cfg
         self.programs = list(programs)
         self.state_spec = state_spec
@@ -80,6 +81,21 @@ class Runtime:
             else np.zeros(cfg.n_nodes, np.int32), np.int32)
         self.invariant = invariant
         self.extensions = list(extensions)
+        self._halt_when = halt_when
+        if lint:
+            # the DetSan construction gate (analyze/lint.py, DESIGN §14):
+            # lint=True raises on active findings BEFORE anything traces,
+            # lint="warn" prints them and proceeds. Off by default — the
+            # repo-wide `python -m madsim_tpu.analyze` gate covers source
+            # statically; this flag adds the closure checks only live
+            # objects allow (sig-degrade, mutable captures).
+            from ..analyze.lint import (DeterminismLintError, active,
+                                        lint_runtime)
+            bad = active(lint_runtime(self))
+            if bad and lint != "warn":
+                raise DeterminismLintError(bad)
+            for f in bad:
+                print(f"detsan warn: {f.format()}")
         self._step = make_step(cfg, self.programs, self.node_prog,
                                self.state_spec, invariant, persist=persist,
                                halt_when=halt_when,

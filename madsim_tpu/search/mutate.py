@@ -221,6 +221,20 @@ class KnobPlan:
         return sc
 
 
+def apply_repro_knobs(rt, state, knobs: dict, plan: "KnobPlan" = None):
+    """Re-apply ONE repro handle's knob vector to every lane of a batched
+    init state — the `(seed, knobs[, nudge])` replay idiom shared by
+    `pct_sweep` and `analyze/races` (confirm/replay/scan). Infers the
+    KnobPlan's dup-slot count from the vector itself when no plan is
+    given, so a handle loaded from a bucket replays without knowing the
+    campaign's dup_slots. Returns (state, plan)."""
+    if plan is None:
+        plan = KnobPlan.from_runtime(
+            rt, dup_slots=len(np.atleast_1d(knobs["dup_src"])))
+    B = int(np.atleast_1d(np.asarray(state.halted)).shape[0])
+    return plan.apply(state, KnobPlan.stack([knobs] * B)), plan
+
+
 # ---------------------------------------------------------------------------
 # jitted kernels — MODULE-LEVEL jits (the utils/hashing discipline): traces
 # are cached per shape, not per KnobPlan instance, so two campaigns over

@@ -35,19 +35,28 @@ def with_prio_nudge(state, nudge):
 
 
 def pct_sweep(rt, seed: int, nudges, max_steps: int, chunk: int = 512,
-              fused: bool = True):
+              fused: bool = True, knobs: dict | None = None, plan=None):
     """Run ONE seed under many tie-break policies in one batch: lane i
     replays `seed` with prio_nudge = nudges[i]. The distinct-schedule
     count over the sweep measures how much of the seed's behavior was
     tie-break luck vs forced by timing.
 
+    `knobs` (one lane's fuzz knob vector, with its KnobPlan) replays a
+    MUTANT under the nudge sweep — the handle a fuzz crash repro or a
+    race bucket carries; the knobs' own prio_nudge is overridden by the
+    sweep per lane (that override IS the sweep). This is what
+    `analyze.races.confirm_race` builds its forced-commute batch from.
+
     Returns a dict with per-lane u64 schedule hashes, the distinct count,
     and {nudge: crash_code} for lanes that crashed (each is replayable
-    alone via the same (seed, nudge) pair)."""
+    alone via the same (seed, [knobs,] nudge) handle)."""
     nudges = np.asarray(nudges, np.int32).reshape(-1)
     B = nudges.shape[0]
-    state = with_prio_nudge(
-        rt.init_batch(np.full(B, seed, np.uint32)), nudges)
+    state = rt.init_batch(np.full(B, seed, np.uint32))
+    if knobs is not None:
+        from .mutate import apply_repro_knobs
+        state, plan = apply_repro_knobs(rt, state, knobs, plan)
+    state = with_prio_nudge(state, nudges)
     if fused:
         state = rt.run_fused(state, max_steps, chunk)
     else:

@@ -18,6 +18,11 @@ this module keeps the durable bucket files in a `CorpusStore`:
                             (flow arrows = the causal chain, r10)
   buckets.jsonl             one line per bucketed observation (telemetry)
 
+Buckets are not only crashes: confirmed SCHEDULE RACES (analyze/races.py)
+land here too, under `obs.causal.race_fingerprint` — same files, same
+dedup machinery, with the repro handle extended to (seed, knobs, nudge)
+since a race only manifests under its PCT tie-break policy.
+
 Cross-process dedup is mostly by construction: two workers that compute
 the same fingerprint race to `os.replace` the same file name — last
 writer wins with equivalent content. The residual race (two workers
@@ -64,23 +69,33 @@ class CrashBuckets:
 
     def observe(self, fp: dict, *, seed: int, knobs: dict | None,
                 round_no: int, worker_id: int, chain: list | None = None,
-                state=None, lane: int | None = None) -> tuple[str, bool]:
+                state=None, lane: int | None = None,
+                nudge: int | None = None) -> tuple[str, bool]:
         """Fold one crash observation in. Returns (bucket key, opened):
         `opened` is True when this observation created a new bucket (and
         wrote its repro + trace artifacts); an observation matching an
         existing bucket only appends a telemetry line — the first repro
-        stays the bucket's canonical handle."""
+        stays the bucket's canonical handle.
+
+        `nudge` extends the repro handle for CONFIRMED SCHEDULE RACES
+        (analyze/races.py, fp kind="race"): the race only manifests
+        under that PCT tie-break policy, so the full replay handle is
+        (seed, knobs, nudge) — `search.pct.with_prio_nudge` applies the
+        third leg at replay."""
         self.refresh()
         key = self._match(fp)
         opened = key is None
         if opened:
             key = fp["key"]
+            repro = dict(seed=int(seed), round=int(round_no),
+                         worker_id=int(worker_id))
+            if nudge is not None:
+                repro["nudge"] = int(nudge)
             rec = dict(
                 key=key, fingerprint=fp,
                 crash_code=fp["crash_code"], crash_node=fp["crash_node"],
                 chain=[{k: int(c[k]) for k in c} for c in (chain or [])],
-                repro=dict(seed=int(seed), round=int(round_no),
-                           worker_id=int(worker_id)),
+                repro=repro,
                 created_at=time.time())
             self.store.write_bucket(key, rec, knobs=knobs)
             if state is not None and lane is not None:

@@ -53,6 +53,12 @@ case "$tier" in
     # uninterrupted run, and a structurally different runtime must be
     # rejected by the store's version/signature contract
     python bench.py --campaign-smoke
+    # DetSan smoke: the repo-wide determinism lint gate must be clean,
+    # a seeded schedule race must confirm via the forced-commute PCT
+    # nudge with a replayable (seed, knobs, nudge) repro and dedupe
+    # into one bucket, and the detsan double-run sanitizer must pass on
+    # a clean runtime while its differ catches a planted divergence
+    python bench.py --analyze-smoke
     if [[ "${2:-}" == "--compile-smoke" ]]; then
       # shared step-program cache smoke: two structurally-equal configs
       # must cost exactly one retrace and stay bitwise-equal to a
