@@ -757,12 +757,27 @@ def _search_ab_mode():
                    Raft's crash rate was near-zero by design).
 
     Reports distinct schedules and distinct crash codes per device-second
-    for each side. Writes BENCH_search_ab_<platform>.json."""
-    _preflight_or_cpu("--search-ab")
+    for each side. Writes BENCH_search_ab_<platform>.json.
+
+    `--shards N` (r13) grows a mesh axis: the fuzzer side runs the
+    mesh-sharded campaign driver (search/shard.py) over N devices at
+    batch/N lanes per shard — total budget stays equal to blind's. On
+    CPU the virtual mesh is forced up front (honest CPU numbers until
+    the TPU tunnel answers — the on-chip variant is on the ROADMAP
+    wishlist); batch must divide by N."""
+    shards = 1
+    if "--shards" in sys.argv:
+        shards = int(sys.argv[sys.argv.index("--shards") + 1])
+    if shards > 1:
+        # the mesh must exist before jax's backend initializes; this
+        # forces the host platform (the CPU-mesh variant of the mode)
+        _force_cpu_mesh_bench(shards)
+    else:
+        _preflight_or_cpu("--search-ab")
     import jax
-    from madsim_tpu import explore, fuzz
+    from madsim_tpu import explore, fuzz, fuzz_sharded
     platform = jax.devices()[0].platform
-    out = {"metric": "search_ab", "platform": platform,
+    out = {"metric": "search_ab", "platform": platform, "shards": shards,
            "note": ("equal budget = same rounds x batch x max_steps per "
                     "side. In the saturating regime blind explore() goes "
                     "dry after round 0 and the fuzzer must beat it "
@@ -780,19 +795,35 @@ def _search_ab_mode():
 
     def ab(name, make, rounds, batch, steps, chunk):
         row = {"rounds": rounds, "batch": batch, "max_steps": steps}
+        if shards > 1:
+            assert batch % shards == 0, (batch, shards)
+
+        def run_fuzzer(rt):
+            if shards == 1:
+                return fuzz(rt, max_steps=steps, batch=batch,
+                            max_rounds=rounds, dry_rounds=rounds + 1,
+                            chunk=chunk)
+            return fuzz_sharded(rt, max_steps=steps,
+                                batch=batch // shards, shards=shards,
+                                max_rounds=rounds, dry_rounds=rounds + 1,
+                                chunk=chunk)
+
         # warm both sides' executables outside the timed region
         warm = make()
         explore(warm, max_steps=steps, batch=batch, max_rounds=1,
                 dry_rounds=2, chunk=chunk)
-        fuzz(warm, max_steps=steps, batch=batch, max_rounds=2,
-             dry_rounds=3, chunk=chunk)
+        if shards == 1:
+            fuzz(warm, max_steps=steps, batch=batch, max_rounds=2,
+                 dry_rounds=3, chunk=chunk)
+        else:
+            fuzz_sharded(warm, max_steps=steps, batch=batch // shards,
+                         shards=shards, max_rounds=2, dry_rounds=3,
+                         chunk=chunk)
         for side, run in (
                 ("blind", lambda rt: explore(
                     rt, max_steps=steps, batch=batch, max_rounds=rounds,
                     dry_rounds=rounds + 1, chunk=chunk)),
-                ("fuzzer", lambda rt: fuzz(
-                    rt, max_steps=steps, batch=batch, max_rounds=rounds,
-                    dry_rounds=rounds + 1, chunk=chunk))):
+                ("fuzzer", run_fuzzer)):
             rt = make()
             t0 = time.perf_counter()
             res = run(rt)
@@ -838,8 +869,9 @@ def _search_ab_mode():
     out["fuzzer_beats_blind_on_saturating"] = (
         sat["fuzzer"]["distinct_schedules"]
         > sat["blind"]["distinct_schedules"])
+    suffix = f"_shards{shards}" if shards > 1 else ""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        f"BENCH_search_ab_{platform}.json")
+                        f"BENCH_search_ab_{platform}{suffix}.json")
     with open(path, "w") as f:
         json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
                   indent=1)
@@ -1057,6 +1089,201 @@ def _campaign_smoke_mode():
             "crash_observations": rep["crash_observations"],
             "killed_at_round": killed_at,
             "resume_matches_uninterrupted": True,
+            "wall_s": round(time.perf_counter() - t0, 1)}))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _force_cpu_mesh_bench(n: int):
+    """Force the host platform with >= n virtual devices for the shard
+    modes — the repo driver's recipe (__graft_entry__._force_cpu_mesh),
+    which must run before anything initializes the XLA backend."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from __graft_entry__ import _force_cpu_mesh
+    return _force_cpu_mesh(n)
+
+
+def _shard_mode():
+    """--mode shard: mesh-sharded campaign scaling (search/shard.py) on
+    an honest CPU mesh — schedules-explored-per-second at EQUAL
+    PER-SHARD budget (same rounds x per-shard batch x max_steps each) as
+    the mesh grows 1 -> 2 -> 4 -> 8 virtual devices, on the crash-rich
+    wal_kv matrix. Each shard is one more device running the same
+    per-shard campaign; the wall should stay ~flat while explored
+    lanes (and on this workload, distinct coverage) scale with the mesh.
+    Also asserts the acceptance bit: a 1-shard sharded campaign writes a
+    BYTE-IDENTICAL durable store to the unsharded fuzzer (entry files,
+    coverage keys, scheduler order+energies). Writes
+    BENCH_shard_cpu.json. On-chip numbers ride the ROADMAP TPU wishlist
+    (`--mode search_ab --shards N`)."""
+    import shutil
+    import tempfile
+    shards_axis = (1, 2, 4, 8)
+    _force_cpu_mesh_bench(max(shards_axis))
+    from madsim_tpu import fuzz, fuzz_sharded
+    from madsim_tpu.service import CorpusStore
+    rounds, batch, steps, chunk = 3, 48, 4096, 512
+    make = functools.partial(_make_crashrich_runtime, "wal_kv")
+    out = {"metric": "shard_scale", "platform": "cpu",
+           "workload": "crashrich_wal_kv",
+           "budget": {"rounds": rounds, "batch_per_shard": batch,
+                      "max_steps": steps},
+           "note": ("equal PER-SHARD budget: every shard runs the same "
+                    "rounds x batch x max_steps; scaling is "
+                    "schedules-explored-per-second (lanes dispatched / "
+                    "wall — each lane is one schedule sample) on a "
+                    "virtual CPU mesh, where device partitions execute "
+                    "on host threads. distinct_per_sec rides along: "
+                    "wal_kv's randomized arrivals keep most lanes on "
+                    "distinct schedules, so coverage scales too."),
+           "shards": {}}
+    for S in shards_axis:
+        # warm this mesh width's executables (sharded layouts compile
+        # per width) outside the timed region — 2 rounds so the masked
+        # havoc dispatch (first used in round 1) is warm too
+        fuzz_sharded(make(), max_steps=steps, batch=batch, shards=S,
+                     max_rounds=2, dry_rounds=3, chunk=chunk)
+        rt = make()
+        t0 = time.perf_counter()
+        res = fuzz_sharded(rt, max_steps=steps, batch=batch, shards=S,
+                           max_rounds=rounds, dry_rounds=rounds + 1,
+                           chunk=chunk)
+        dt = time.perf_counter() - t0
+        out["shards"][S] = {
+            "lanes_run": res["seeds_run"],
+            "distinct_schedules": res["distinct_schedules"],
+            "wall_s": round(dt, 2),
+            "schedules_explored_per_sec": round(res["seeds_run"] / dt, 1),
+            "distinct_per_sec": round(res["distinct_schedules"] / dt, 1),
+            "corpus_size": res["corpus_size"],
+        }
+        print(f"--shard: {S} shard(s): {res['seeds_run']} lanes in "
+              f"{dt:.1f}s = {res['seeds_run'] / dt:,.0f} sched/s, "
+              f"{res['distinct_schedules']} distinct", file=sys.stderr)
+    e1 = out["shards"][1]["schedules_explored_per_sec"]
+    for S in shards_axis[1:]:
+        out[f"scaling_1_to_{S}"] = round(
+            out["shards"][S]["schedules_explored_per_sec"] / e1, 2)
+    # the acceptance bit: 1-shard sharded == unsharded fuzzer, down to
+    # store bytes
+    root = tempfile.mkdtemp(prefix="madsim_shard_bench_")
+    try:
+        kw = dict(max_steps=1500, batch=16, max_rounds=2, dry_rounds=9,
+                  chunk=256)
+        da, db = os.path.join(root, "a"), os.path.join(root, "b")
+        fuzz(make(), corpus_dir=da, **kw)
+        fuzz_sharded(make(), shards=1, corpus_dir=db, **kw)
+        sa = CorpusStore(da, create=False)
+        sb = CorpusStore(db, create=False)
+        names = sa.entry_names()
+        assert names == sb.entry_names(), "entry sets differ"
+        assert sa.coverage_keys() == sb.coverage_keys()
+        assert all(
+            open(os.path.join(da, "entries", n), "rb").read()
+            == open(os.path.join(db, "entries", n), "rb").read()
+            for n in names), "entry files not byte-identical"
+        wa = sa.load_worker_state(0)
+        gb = sb.load_shard_group_state(0)["shard_states"][0]
+        assert wa["order"] == gb["order"], "scheduler order/energies differ"
+        out["one_shard_bit_identical"] = True
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_shard_cpu.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _shard_smoke_mode():
+    """--shard-smoke: seconds-scale mesh-sharded-campaign self-test for
+    CI (scripts/ci.sh fast), on a 2-shard virtual CPU mesh:
+
+      equivalence  a 1-shard sharded campaign must write a byte-
+                   identical durable store to the unsharded fuzzer
+                   (entry files, coverage keys, scheduler order)
+      merge        a 2-shard campaign's merged coverage must be a
+                   superset of every shard's own, entries must land in
+                   both shard namespaces, and the consensus tally must
+                   serialize
+      durability   a 2-shard campaign split across two calls must end
+                   equal to the uninterrupted control (entries +
+                   coverage + group state), with the run-twice
+                   verify_resume guard armed on the resumed call
+    """
+    import shutil
+    import tempfile
+    _force_cpu_mesh_bench(2)
+    t0 = time.perf_counter()
+    from madsim_tpu import fuzz, fuzz_sharded
+    from madsim_tpu.search.shard import shard_worker_id
+    from madsim_tpu.service import CorpusStore
+    root = tempfile.mkdtemp(prefix="madsim_shard_smoke_")
+    try:
+        kw = dict(max_steps=400, batch=16, max_rounds=3, dry_rounds=9,
+                  chunk=128)
+        # -- 1-shard bit-identity ---------------------------------------
+        da, db = os.path.join(root, "a"), os.path.join(root, "b")
+        fuzz(_make_saturating_runtime(), corpus_dir=da, **kw)
+        r1 = fuzz_sharded(_make_saturating_runtime(), shards=1,
+                          corpus_dir=db, **kw)
+        sa = CorpusStore(da, create=False)
+        sb = CorpusStore(db, create=False)
+        names = sa.entry_names()
+        assert names == sb.entry_names()
+        assert sa.coverage_keys() == sb.coverage_keys()
+        assert all(
+            open(os.path.join(da, "entries", n), "rb").read()
+            == open(os.path.join(db, "entries", n), "rb").read()
+            for n in names), "1-shard store not byte-identical to fuzz()"
+        assert (sa.load_worker_state(0)["order"]
+                == sb.load_shard_group_state(0)["shard_states"][0]["order"])
+        # -- 2-shard merge ----------------------------------------------
+        r2 = fuzz_sharded(_make_saturating_runtime(sketch_slots=8),
+                          shards=2, **kw)
+        assert r2["shards"] == 2
+        for row in r2["per_shard"]:
+            # merged coverage is a superset of each shard's own view
+            assert row["coverage"] <= r2["distinct_schedules"]
+            assert row["worker_id"] == shard_worker_id(0, row["shard"], 2)
+        # -- 2-shard split == continuous, verify_resume armed -----------
+        dc, dd = os.path.join(root, "c"), os.path.join(root, "d")
+        kw2 = dict(kw, shards=2)
+        fuzz_sharded(_make_saturating_runtime(), corpus_dir=dc,
+                     **dict(kw2, max_rounds=2))
+        rs = fuzz_sharded(_make_saturating_runtime(), corpus_dir=dc,
+                          verify_resume=True, **dict(kw2, max_rounds=4))
+        rc = fuzz_sharded(_make_saturating_runtime(), corpus_dir=dd,
+                          **dict(kw2, max_rounds=4))
+        sc_ = CorpusStore(dc, create=False)
+        sd = CorpusStore(dd, create=False)
+        assert rs["rounds_done_total"] == 4 and rc["rounds_done_total"] == 4
+        assert sc_.entry_names() == sd.entry_names()
+        assert sc_.coverage_keys() == sd.coverage_keys()
+        gc_ = sc_.load_shard_group_state(0)
+        gd = sd.load_shard_group_state(0)
+        assert [s["order"] for s in gc_["shard_states"]] \
+            == [s["order"] for s in gd["shard_states"]]
+        assert gc_["tally"] == gd["tally"]
+        # namespaced entries from BOTH shards present, and each shard's
+        # LIVE corpus holds foreign-namespace entries — the cross-shard
+        # merge actually delivered, not just co-located files
+        ws = {n.split("-")[0] for n in sc_.entry_names()}
+        assert ws == {"w0000", "w0001"}, ws
+        from madsim_tpu.search.corpus import split_entry_id
+        for s, st in enumerate(gc_["shard_states"]):
+            owners = {split_entry_id(int(eid))[0] for eid, _ in st["order"]}
+            assert owners == {0, 1}, (s, owners)
+        print(json.dumps({
+            "metric": "shard_smoke", "platform": "cpu", "ok": True,
+            "one_shard_entries": len(names),
+            "one_shard_bit_identical": True,
+            "two_shard_distinct": r2["distinct_schedules"],
+            "two_shard_per_shard": [row["coverage"]
+                                    for row in r2["per_shard"]],
+            "split_equals_continuous": True,
+            "verify_resume_armed": True,
             "wall_s": round(time.perf_counter() - t0, 1)}))
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -1870,7 +2097,8 @@ def main():
                  "--obs-ab", "--obs-smoke", "--compile-ab",
                  "--compile-smoke", "--search-ab", "--search-smoke",
                  "--causal-ab", "--causal-smoke", "--campaign",
-                 "--campaign-smoke", "--analyze-smoke", "--detsan-ab"}
+                 "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
+                 "--shard", "--shard-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
@@ -1880,6 +2108,12 @@ def main():
         return
     if "--detsan-ab" in sys.argv:
         _detsan_ab_mode()
+        return
+    if "--shard-smoke" in sys.argv:
+        _shard_smoke_mode()
+        return
+    if "--shard" in sys.argv:
+        _shard_mode()
         return
     if "--campaign-smoke" in sys.argv:
         _campaign_smoke_mode()

@@ -1,6 +1,6 @@
 """An always-on fuzzing service: N workers, one durable corpus dir.
 
-    python examples/fuzz_service.py CORPUS_DIR [workers] [rounds]
+    python examples/fuzz_service.py CORPUS_DIR [workers] [rounds] [shards]
 
 The CI-farm shape (ROADMAP "production traffic"): every invocation
 RESUMES the campaign in CORPUS_DIR — worker processes pick up at their
@@ -9,7 +9,10 @@ dedup crashes into shared causal-fingerprint buckets. Kill it however
 you like (Ctrl-C, SIGKILL, power loss): nothing past the last round sync
 is lost, and the next invocation converges to the run that was never
 killed. Run it again with a larger `rounds` to keep an existing campaign
-growing.
+growing. `shards` > 1 makes every worker a mesh-sharded campaign of
+that width (DESIGN §15 — worker processes force their own virtual CPU
+mesh; on real chips pin one worker per host and let the mesh span its
+devices): processes x shards compose, all namespaces stay disjoint.
 
 Prints live campaign stats while the workers run, then the merged
 report: coverage, per-worker rounds, and one line per deduped crash
@@ -41,13 +44,14 @@ def main():
     corpus_dir = sys.argv[1]
     workers = int(sys.argv[2]) if len(sys.argv) > 2 else 2
     rounds = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    shards = int(sys.argv[4]) if len(sys.argv) > 4 else 1
 
-    print(f"campaign: {workers} workers x {rounds} rounds (campaign "
-          f"total) -> {corpus_dir}")
+    print(f"campaign: {workers} workers x {shards} shard(s) x {rounds} "
+          f"rounds (campaign total) -> {corpus_dir}")
     try:
         rep = run_campaign(
             FACTORY, corpus_dir, workers=workers, max_rounds=rounds,
-            max_steps=4096, batch=48, chunk=512,
+            max_steps=4096, batch=48, chunk=512, shards=shards,
             factory_kwargs=FACTORY_KWARGS, observer=ProgressObserver(),
             poll_s=1.0)
     except KeyboardInterrupt:

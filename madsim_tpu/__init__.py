@@ -44,9 +44,10 @@ from .parallel.stats import (divergence_profile, schedule_representatives,
                              summarize)
 from .runtime.runtime import Runtime
 from .runtime.scenario import Scenario
-from .search import Corpus, KnobPlan, fuzz, pct_sweep, with_prio_nudge
+from .search import (Corpus, KnobPlan, fuzz, fuzz_sharded, pct_sweep,
+                     with_prio_nudge)
 from .service import (CorpusStore, campaign_report, merged_buckets,
-                      replay_bucket, run_campaign)
+                      replay_bucket, run_campaign, supervise_campaign)
 
 __version__ = "0.1.0"
 
@@ -57,11 +58,12 @@ __all__ = [
     "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
     "explore", "minimize_scenario", "summarize", "schedule_representatives",
     "find_divergence",
-    "fuzz", "Corpus", "KnobPlan", "pct_sweep", "with_prio_nudge",
+    "fuzz", "fuzz_sharded", "Corpus", "KnobPlan", "pct_sweep",
+    "with_prio_nudge",
     "SweepObserver", "JsonlObserver", "ProgressObserver", "ring_records",
     "export_chrome_trace", "explain_crash", "divergence_profile",
-    "CorpusStore", "run_campaign", "campaign_report", "merged_buckets",
-    "replay_bucket",
+    "CorpusStore", "run_campaign", "supervise_campaign", "campaign_report",
+    "merged_buckets", "replay_bucket",
     "lint_runtime", "find_races", "confirm_race", "scan_races",
     "detsan_check", "DetSanFailure",
 ]

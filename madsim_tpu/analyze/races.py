@@ -278,17 +278,11 @@ def replay_race(rt, repro: dict, *, plan=None, max_steps: int = 20_000,
     out = once()
     if not verify:
         return out
-    again = once()
-    if again == out:
-        return out
-    third = once()
-    if third != again:
-        raise RuntimeError(
-            f"race repro does not replay deterministically: three "
-            f"invocations gave {out['fingerprint']}, "
-            f"{again['fingerprint']}, {third['fingerprint']} — this is "
-            f"beyond the known first-invocation compile-cache transient")
-    return again
+    from ..utils.verify import agree_twice
+    return agree_twice(
+        out, lambda _: once(), what="race repro",
+        detail=lambda a, b, c: (f"fingerprints {a['fingerprint']}, "
+                                f"{b['fingerprint']}, {c['fingerprint']}"))
 
 
 def _dedupe_key(cand: dict) -> tuple:

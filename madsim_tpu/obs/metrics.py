@@ -17,11 +17,18 @@ blocked on anyway. Record kinds (each a flat JSON-able dict carrying
            round's median first-divergence slot vs the consensus prefix)
            when the build compiles the prefix sketch in
            (cfg.sketch_slots > 0) — depth telemetry riding the sketch
-           transfer the corpus already pays for. A multi-process campaign
-           driver (service/campaign.py) emits kind="campaign" rounds:
-           uptime_s, workers_alive, corpus_entries, coverage_keys,
-           buckets, schedules_per_sec, buckets_per_min — the campaign-
-           level rollup polled from the shared corpus dir
+           transfer the corpus already pays for. Mesh-sharded campaigns
+           (search/shard.py) add shards (mesh width) and per_shard —
+           one row per device shard: {shard, worker_id, corpus_size,
+           coverage, new, crashes, seeds_run} — so renderers can show
+           the mesh instead of collapsing it into one line
+           (ProgressObserver prints one row per shard). A multi-process
+           campaign driver (service/campaign.py) emits kind="campaign"
+           rounds: uptime_s, workers_alive, corpus_entries,
+           coverage_keys, buckets, schedules_per_sec, buckets_per_min —
+           the campaign-level rollup polled from the shared corpus dir —
+           and `supervise_campaign` emits kind="supervisor" segment
+           records: segment, max_rounds, dead_workers, restarts, pruned
   compile  a runner retraced (= a fresh executable was built, modulo
            persistent-cache compile skips): label (chunk_runner /
            fused_runner / inject), batch, chunk. Fired by

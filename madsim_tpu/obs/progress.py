@@ -70,14 +70,43 @@ class ProgressObserver:
                 f"buckets {rec['buckets']}  "
                 f"{rec['schedules_per_sec']:.1f} sched/s", force=True)
             return
+        if rec.get("kind") == "supervisor":
+            # service.supervise_campaign segment boundary
+            dead = rec.get("dead_workers") or []
+            self._show(
+                f"supervisor seg {rec['segment']}  "
+                f"rounds->{rec['max_rounds']}  "
+                f"restarts {rec['restarts']}  pruned {rec['pruned']}"
+                + (f"  dead {dead}" if dead else ""), force=True)
+            self._line_open = False
+            self.stream.write("\n")
+            return
         # explore() rounds and fuzz() rounds share the schema; fuzz adds
         # corpus_size (and kind="fuzz_round")
         corpus = (f"  corpus {rec['corpus_size']}"
                   if "corpus_size" in rec else "")
+        shards = (f"  x{rec['shards']} shards"
+                  if rec.get("shards", 1) > 1 else "")
         self._show(
             f"round {rec['round']:>3}  +{rec['new_schedules']} new "
             f"schedules ({rec['distinct_total']} distinct)  "
-            f"crashes {rec['crashes']}{corpus}", force=True)
+            f"crashes {rec['crashes']}{corpus}{shards}", force=True)
+        if rec.get("shards", 1) > 1 and rec.get("per_shard"):
+            # one row per shard — a mesh campaign's telemetry must not
+            # collapse the mesh into one line (wall_s is the round's
+            # campaign wall: shards run concurrently, so per-shard
+            # rates share it)
+            wall = max(rec.get("wall_s", 0.0), 1e-9)
+            self.stream.write("\n")
+            for row in rec["per_shard"]:
+                self.stream.write(
+                    f"  shard {row['shard']} (w{row['worker_id']})  "
+                    f"corpus {row['corpus_size']:>4}  "
+                    f"coverage {row['coverage']:>5}  "
+                    f"+{row['new']} new  crashes {row['crashes']}  "
+                    f"{_rate(row['seeds_run'] / wall)} sched/s\n")
+            self.stream.flush()
+            self._line_open = False
 
     def on_done(self, rec):
         parts = [f"done: {rec.get('steps_done', rec.get('seeds_run', 0))} "

@@ -73,6 +73,42 @@ def sched_hash_u64(state) -> np.ndarray:
     return (h[..., 0] << np.uint64(32)) | h[..., 1]
 
 
+@jax.jit
+def _consensus_modal(sketches):
+    """Per-slot modal sketch value over the whole (possibly sharded)
+    batch, ties to the SMALLEST value — the `first_divergence_slots`
+    consensus rule, computed on device in O(B log B) per slot (sort +
+    rank-difference run lengths; no [B, B] compare, so the working set
+    stays [B, S] however wide the mesh grows). Under a mesh the
+    per-slot sort is a batch-global op — one gather across shards."""
+    def one(col):
+        v = jnp.sort(col)
+        counts = (jnp.searchsorted(v, v, side="right")
+                  - jnp.searchsorted(v, v, side="left"))
+        # argmax takes the FIRST maximal count; v ascends, and every
+        # occurrence of a value shares its count, so the first max IS
+        # the smallest modal value — the ties-to-smallest rule
+        return v[jnp.argmax(counts)]
+
+    return jax.vmap(one, in_axes=1)(sketches)
+
+
+def consensus_allreduce(sketches) -> np.ndarray:
+    """The cross-shard consensus fold (r13): one device reduction over a
+    mesh-sharded [B, S] prefix-sketch batch yielding the batch-global
+    per-slot modal value (ties to smallest — bit-compatible with the
+    host rule in `first_divergence_slots(consensus=None)`, which the
+    tests assert). The sharded fuzz driver uses it for round-level
+    divergence telemetry: the modal is computed where the sketch lanes
+    live instead of re-deriving it in host numpy. (The per-lane sketch
+    batch itself still reaches the host — each shard's corpus needs
+    per-lane attribution, the same bill fuzz() pays — so this saves the
+    host-side mode pass, not the transfer.) The corpus's CROSS-ROUND
+    consensus counters remain host state (search/corpus.py) and merge
+    across shards at sync points."""
+    return np.asarray(_consensus_modal(jnp.asarray(sketches)))
+
+
 def first_divergence_slots(sketches, consensus=None) -> np.ndarray:
     """Per-lane first-divergence slot from a [B, S] prefix-sketch array
     (SimState.cov_sketch): the first slot where a lane's sketch differs

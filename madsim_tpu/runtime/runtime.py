@@ -337,6 +337,30 @@ class Runtime:
         return self._fused_runner(state, jnp.asarray(n_chunks, jnp.int32),
                                   chunk)
 
+    def run_fused_sharded(self, state: SimState, max_steps: int,
+                          chunk: int = 512, mesh=None) -> SimState:
+        """Lane→shard plumbing (r13): place `state`'s leading [B] lane
+        axis over a device mesh and drive it with the fused runner as
+        ONE SPMD dispatch. Lanes never talk to each other, so the only
+        cross-shard traffic is the while_loop predicate's `halted.all()`
+        — an all-reduce per chunk riding ICI (or host threads on a
+        virtual CPU mesh), no host round-trips.
+
+        Unlike `parallel.distributed.run_fused_sharded` (which builds
+        the batch FROM seeds and handles multi-process assembly), this
+        takes an already-built batched state — the entry point the
+        sharded fuzz driver needs, where knob mutation has already been
+        applied to the init state before it shards. `mesh` defaults to
+        a 1-D 'seeds' mesh over every local device; B must divide the
+        mesh size. A 1-device mesh is the bitwise-degenerate case: the
+        sharded executable computes exactly the unsharded values
+        (tests/test_shard.py holds the whole-campaign version of that).
+        Input buffers are donated, like `run_fused`."""
+        from ..parallel.mesh import seed_mesh, shard_batch
+        if mesh is None:
+            mesh = seed_mesh()
+        return self.run_fused(shard_batch(state, mesh), max_steps, chunk)
+
     def run(self, state: SimState, max_steps: int, chunk: int = 512,
             collect_events: bool = False, observer=None):
         """Advance until every trajectory halts or ~max_steps events each

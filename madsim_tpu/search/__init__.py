@@ -6,14 +6,19 @@ schedule space instead of sampling it.
   mutate.py   the per-lane knob schema + jitted on-device mutation engine
   pct.py      PCT-style tie-break perturbation (SimState.prio_nudge)
   fuzz.py     the pipelined loop-until-dry driver
+  shard.py    the mesh-sharded campaign driver (r13): device-local
+              corpus shards, on-device mutation fan-out, all-gather
+              coverage merge
 
-See DESIGN.md §11 "Search discipline".
+See DESIGN.md §11 "Search discipline" and §15 "Sharding discipline".
 """
 
-from .corpus import Corpus
+from .corpus import Corpus, merge_consensus
 from .fuzz import fuzz
 from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
 from .pct import pct_sweep, with_prio_nudge
+from .shard import fuzz_sharded, shard_worker_id
 
-__all__ = ["Corpus", "KnobPlan", "fuzz", "pct_sweep", "with_prio_nudge",
+__all__ = ["Corpus", "KnobPlan", "fuzz", "fuzz_sharded", "pct_sweep",
+           "with_prio_nudge", "merge_consensus", "shard_worker_id",
            "OP_NAMES", "N_MUT_OPS"]

@@ -93,6 +93,21 @@ class Corpus:
         # accumulate dead entries
         self.track_evictions = False
         self.evicted_unsynced: list[dict] = []
+        # mesh-shard hook (r13, search/shard.py): when on, observe()
+        # also queues each OWN admission into an outbox the sharded
+        # driver drains at merge points — the in-memory counterpart of
+        # the store's immutable entry files, so shard corpora can
+        # exchange exactly the entries admitted since the last merge.
+        # Foreign admissions (admit_foreign) never enter the outbox:
+        # re-broadcasting them would only ping-pong already-shared keys.
+        self.track_admissions = False
+        self.admitted_unmerged: list[dict] = []
+        # consensus DELTA counters (shard mode only): what this corpus
+        # folded since the last cross-shard merge. merge_consensus()
+        # drains them into the campaign tally, so repeated merges never
+        # double-count the shared history. Never pruned — bounded by
+        # the lanes observed between two merges.
+        self._slot_delta: list[dict[int, int]] | None = None
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -123,11 +138,16 @@ class Corpus:
     def _fold_sketches(self, sk: np.ndarray) -> None:
         if self._slot_counts is None:
             self._slot_counts = [dict() for _ in range(sk.shape[1])]
+        if self.track_admissions and self._slot_delta is None:
+            self._slot_delta = [dict() for _ in range(sk.shape[1])]
         for j in range(sk.shape[1]):
             counts = self._slot_counts[j]
             vals, cnts = np.unique(sk[:, j], return_counts=True)
             for v, c in zip(vals.tolist(), cnts.tolist()):
                 counts[int(v)] = counts.get(int(v), 0) + int(c)
+                if self._slot_delta is not None:
+                    dj = self._slot_delta[j]
+                    dj[int(v)] = dj.get(int(v), 0) + int(c)
             if len(counts) > 8192:
                 # bound the per-slot tally on very long campaigns: keep
                 # the hottest half, deterministically (count desc, value
@@ -220,6 +240,8 @@ class Corpus:
                          crash_code=int(codes[i]) if hit_crash else 0)
             self._next_id += 1
             self._insert(entry)
+            if self.track_admissions:
+                self.admitted_unmerged.append(entry)
             parent = self._by_id.get(int(parent_ids[i]))
             if parent is not None:
                 parent["energy"] = min(
@@ -246,3 +268,48 @@ class Corpus:
                     out[i] = ent["knobs"]
                     ids[i] = ent["id"]
         return KnobPlan.stack(out), ids
+
+
+def merge_consensus(corpora, tally=None):
+    """The consensus all-reduce, applied to corpus state (r13): drain
+    every shard corpus's DELTA counters (what it folded since the last
+    merge) into the campaign tally, then install an independent copy of
+    the tally as every corpus's consensus counters — afterwards each
+    shard's divergence energy measures novelty against the whole
+    campaign's history, not just its own shard's (the r10 cross-shard
+    follow-on). Returns the updated tally; the driver (search/shard.py)
+    threads it between merges.
+
+    Delta-based on purpose: installing the tally and then re-summing
+    whole counter sets at the next merge would count the shared history
+    once per shard. Summing only the per-shard deltas keeps the tally
+    exact, and makes the fold associative/commutative — merge order
+    cannot fork shards. Deltas never prune (`_fold_sketches` bounds
+    them by the lanes between merges); the tally itself is pruned with
+    the same deterministic rule as a corpus's own counters, applied at
+    install time, so every shard holds the identical post-prune view.
+    The 1-shard sharded campaign never calls this (nothing is
+    cross-shard there), keeping it bit-identical to the unsharded
+    fuzzer by construction."""
+    deltas = [c._slot_delta for c in corpora if c._slot_delta is not None]
+    if not deltas and tally is None:
+        return None
+    n_slots = max([len(d) for d in deltas]
+                  + ([len(tally)] if tally is not None else []))
+    merged: list[dict[int, int]] = [
+        dict(tally[j]) if tally is not None and j < len(tally) else dict()
+        for j in range(n_slots)]
+    for d in deltas:
+        for j, counts in enumerate(d):
+            mj = merged[j]
+            for v, c in counts.items():
+                mj[v] = mj.get(v, 0) + c
+    for j, mj in enumerate(merged):
+        if len(mj) > 8192:
+            keep = sorted(mj.items(), key=lambda kv: (-kv[1], kv[0]))[:4096]
+            merged[j] = dict(keep)
+    for c in corpora:
+        c._slot_counts = [dict(s) for s in merged]
+        if c._slot_delta is not None:
+            c._slot_delta = [dict() for _ in range(n_slots)]
+    return merged

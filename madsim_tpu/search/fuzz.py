@@ -57,13 +57,22 @@ from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
 WORKER_SEED_STRIDE = 1 << 26
 
 
+def _env_verify_resume() -> bool:
+    """Default for the run-twice resume guard when the caller passed
+    None: MADSIM_FUZZ_VERIFY_RESUME=1 turns it on fleet-wide (CI and
+    the campaign smokes set it) without touching call sites."""
+    import os
+    return os.environ.get("MADSIM_FUZZ_VERIFY_RESUME", "") not in ("", "0")
+
+
 def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
          dry_rounds: int = 3, base_seed: int = 0, chunk: int = 512,
          pipeline: bool = True, fused: bool = True, dup_slots: int = 2,
          havoc: int = 3, fresh_frac: float = 0.125, rng_seed: int = 0,
          observer=None, minimize: bool = False, corpus: Corpus | None = None,
          div_bonus: float | None = None, corpus_dir: str | None = None,
-         worker_id: int = 0, sync_every: int = 1):
+         worker_id: int = 0, sync_every: int = 1,
+         verify_resume: bool | None = None):
     """Coverage-guided schedule fuzzing over `rt`'s dynamic fault knobs.
 
     Round 0 is a blind bootstrap (base knobs, fresh seeds — one explore()
@@ -99,6 +108,19 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
       sync_every   rounds between durability points (1 = every round).
                    A SIGKILL loses at most the work since the last sync,
                    and the resumed run re-derives it bit-identically.
+      verify_resume  run-twice guard (r13, knob-gated; None reads
+                   MADSIM_FUZZ_VERIFY_RESUME, default off) on the FIRST
+                   round after a resume — exactly the deserialized-
+                   executable invocation where this jaxlib's persistent
+                   compile cache can return a deterministic-but-wrong
+                   result under load (ROADMAP r12 note). The round's
+                   (seeds, knobs) batch is re-dispatched until two
+                   consecutive invocations agree on (hashes, crashed,
+                   codes, sketches), mirroring analyze.replay_race's
+                   contract; three distinct results raise. Resume
+                   equality is replay-authoritative — a corrupted first
+                   invocation would fork the campaign from the run that
+                   was never killed.
 
     observer: obs.metrics.SweepObserver — `on_round` records of kind
     "fuzz_round" (explore's round schema + corpus_size/new_crash_codes),
@@ -118,6 +140,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     """
     plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
     op_hist = np.zeros(N_MUT_OPS, np.int64)
+    if verify_resume is None:
+        verify_resume = _env_verify_resume()
     store = buckets = None
     round_start = 0
     dry = 0
@@ -127,6 +151,17 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         from ..service.store import CorpusStore, store_signature
         store = CorpusStore(corpus_dir,
                             signature=store_signature(rt, plan))
+        # the r13 shard↔worker mapping numerically overlaps plain
+        # worker ids — refuse a namespace a shard GROUP's state already
+        # claims (see CorpusStore.claimed_namespaces / DESIGN §15)
+        owner = store.claimed_namespaces().get(worker_id)
+        if owner is not None and owner != f"worker w{worker_id}":
+            from ..service.store import StoreMismatch
+            raise StoreMismatch(
+                f"worker namespace {worker_id} is already owned by "
+                f"{owner} in this corpus dir — a mesh-sharded group's "
+                "shards occupy worker_id*shards+s; pick a worker_id "
+                "outside every group's range (DESIGN §15)")
         buckets = CrashBuckets(store)
         if corpus is None:
             corpus = store.load_corpus(
@@ -203,6 +238,35 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
                 hist is not None, sketches, state)
 
+    def verified(harvested):
+        """The run-twice resume guard (verify_resume): re-dispatch the
+        SAME (seeds, knobs) batch — the knob batch is never donated —
+        until two consecutive invocations agree on the authoritative
+        outputs (utils.verify.agree_twice: contains the persistent-
+        cache first-invocation corruption, raises on real
+        nondeterminism)."""
+        from ..utils.verify import agree_twice
+
+        def key_of(h):
+            _, _, _, hashes, crashed, codes, _, sketches, _ = h
+            return (hashes.tobytes(), crashed.tobytes(), codes.tobytes(),
+                    None if sketches is None else sketches.tobytes())
+
+        def again(prev):
+            seeds, ids, knobs_host = prev[0], prev[1], prev[2]
+            mutated = prev[6]
+            state = plan.apply(rt.init_batch(seeds), knobs_host)
+            if fused:
+                state = rt.run_fused(state, max_steps, chunk)
+            else:
+                state, _ = rt.run(state, max_steps, chunk)
+            return harvest((seeds, ids, knobs_host,
+                            None if not mutated else
+                            np.zeros(N_MUT_OPS, np.int64), state))
+
+        return agree_twice(harvested, again, key_of,
+                           what="first post-resume campaign round")
+
     # under a durable store, `seen` starts at the campaign's cumulative
     # coverage (this worker's view) so dry-detection and the distinct
     # count continue across resumes instead of restarting from zero
@@ -221,12 +285,17 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     t0 = time.perf_counter()
     pending = (launch(round_start)
                if round_start < max_rounds and dry < dry_rounds else None)
+    verify_round = (round_start if verify_resume and store is not None
+                    and round_start > 0 else None)
     for r in range(round_start, max_rounds):
         if pending is None:
             break
         nxt = (launch(r + 1) if speculate and r + 1 < max_rounds else None)
+        harvested = harvest(pending)
+        if r == verify_round:
+            harvested = verified(harvested)
         (seeds, ids, knobs_host, hashes, crashed, codes,
-         mutated, sketches, state) = harvest(pending)
+         mutated, sketches, state) = harvested
         rounds += 1
         cstats = corpus.observe(knobs_host, seeds, hashes, crashed, codes,
                                 ids, r, sketches=sketches)

@@ -173,6 +173,25 @@ class KnobPlan:
             return kb, jnp.zeros((N_MUT_OPS,), jnp.int32)
         return _mutate_batch(kb, key, self._guards(), havoc)
 
+    def mutate_masked(self, knobs_batch, key, mask, havoc: int = 3):
+        """The SPMD variant for the mesh-sharded driver (search/shard.py):
+        one jitted call over a MESH-SHARDED knob batch, with a per-lane
+        bool `mask` selecting which lanes keep the mutant (False lanes
+        pass their parent through untouched — how bootstrap shards ride
+        a mixed round without a separate dispatch). One executable per
+        mesh width instead of one per device, and the mutation math
+        partitions over the lane axis — it never leaves each shard's
+        device. With mask all-True this computes exactly `mutate()`
+        (same key split, same operators; the selects are identity), so
+        the 1-shard campaign stays bit-identical to the unsharded
+        fuzzer. Returns (device knob batch, histogram over MASKED lanes
+        only — a passed-through lane's draws never count)."""
+        kb = {k: jnp.asarray(v) for k, v in knobs_batch.items()}
+        if havoc <= 0:
+            return kb, jnp.zeros((N_MUT_OPS,), jnp.int32)
+        return _mutate_batch_masked(kb, key, self._guards(), havoc,
+                                    jnp.asarray(mask))
+
     def apply(self, state, knobs_batch):
         """Write a knob batch into a batched init state: scenario slots
         [n_init, n_init+R+D) plus the network/priority scalars. Bounds
@@ -354,6 +373,23 @@ def _mutate_batch(knobs, key, guards, havoc):
     out, hist = jax.vmap(_mutate_one, in_axes=(0, 0, None, None))(
         knobs, keys, guards, havoc)
     return out, hist.sum(0)
+
+
+@functools.partial(jax.jit, static_argnames=("havoc",))
+def _mutate_batch_masked(knobs, key, guards, havoc, mask):
+    COMPILE_LOG.note_trace("mutate_masked",
+                           batch=int(knobs["row_time"].shape[0]),
+                           havoc=havoc)
+    keys = jax.random.split(key, knobs["row_time"].shape[0])
+    out, hist = jax.vmap(_mutate_one, in_axes=(0, 0, None, None))(
+        knobs, keys, guards, havoc)
+
+    def sel(new, old):
+        return jnp.where(mask.reshape((-1,) + (1,) * (new.ndim - 1)),
+                         new, old)
+
+    return ({k: sel(out[k], knobs[k]) for k in knobs},
+            (hist * mask[:, None]).sum(0))
 
 
 @functools.partial(jax.jit, static_argnames=("n_init", "jitter_gate"))

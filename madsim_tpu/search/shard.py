@@ -1,0 +1,546 @@
+"""Mesh-sharded fuzz campaigns: the whole search loop, scaled out (r13).
+
+`fuzz()` (search/fuzz.py) drives one device's worth of lanes from one
+host-side corpus — `run_fused_sharded` ran a sweep SPMD since r6, but the
+SEARCH loop never used it. This driver shards the campaign itself over a
+JAX mesh, the Podracer batched-actor split (PAPERS.md) applied to
+schedule search:
+
+  - each device shard owns a corpus slice and a seed space. A shard is
+    just another worker id (the r11 insight): shard `s` of worker `w` in
+    an `S`-shard campaign mints entry ids in namespace `w*S + s`, runs
+    seeds `WORKER_SEED_STRIDE` apart, and schedules parents with its own
+    rng stream (`rng_seed + s`) — so cross-shard merge is the same
+    merge-by-construction the multi-process campaign already proved;
+  - mutation never leaves the device: the round's parent knob batch
+    lands on the mesh already lane-sharded, and ONE masked SPMD havoc
+    dispatch (`KnobPlan.mutate_masked`) derives every shard's mutants in
+    place — XLA partitions the all-operand mutation math over the lane
+    axis, so each shard's draws happen on its own device, bootstrap
+    shards ride the same dispatch behind the mask, and one executable
+    serves the whole mesh width; `apply_knobs` then writes the mutants
+    into the sharded init state SPMD and the round runs as one fused
+    dispatch whose only cross-shard traffic is the halt all-reduce;
+  - per-round host harvests shrink to the coverage question: the
+    campaign-global dedup rides the all-gathered O(distinct) coverage
+    digest (`parallel.stats.coverage_digest` over the sharded batch —
+    its lexsort lowers to an all-gather + replicated sort, and only the
+    packed distinct prefix crosses to the host via `digest_hashes`),
+    and round-level divergence telemetry rides the on-device consensus
+    all-reduce (`consensus_allreduce`) instead of shipping per-lane
+    sketches to a host modal. Per-shard corpora still read their own
+    [batch] lanes — kilobytes, the same bill `fuzz()` pays per shard;
+  - shards exchange what they learned at MERGE points (every
+    `merge_every` rounds, and at every durability sync): admissions
+    since the last merge flow through each corpus's outbox into every
+    other shard (`admit_foreign` — keyed by coverage, order-independent)
+    and the cross-round consensus sketch counters fold through
+    `corpus.merge_consensus`, so divergence energy rewards novelty
+    against the WHOLE campaign's history — the r10 cross-shard
+    follow-on, one all-reduce wider.
+
+Bit-identity contract: at `shards=1` nothing is cross-shard — no merge
+runs, namespace/seed/rng formulas collapse to `fuzz()`'s — and the
+1-device-mesh executables compute the unsharded values, so the sharded
+campaign is bit-identical to the unsharded fuzzer (coverage keys, entry
+files, energies; tests/test_shard.py holds it over saturating,
+crash-rich wal_kv, and flagship raft).
+
+Durable campaigns (`corpus_dir=`): every shard syncs into the same
+`service.CorpusStore` under its own namespace, but the GROUP's scheduler
+state (all shards' orders/energies/rng + the consensus tally) is one
+atomic json per sync (`state/g<worker>.json`) — a SIGKILL can never tear
+the shards of one worker apart, and a resume restores every shard to the
+same round. Cross-process campaigns compose: another process's shards
+(or plain `fuzz()` workers) are just more namespaces merged at sync.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from ..parallel import stats
+from ..parallel.mesh import SEED_AXIS, seed_mesh
+from .corpus import Corpus, merge_consensus
+from .fuzz import WORKER_SEED_STRIDE, _env_verify_resume
+from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
+
+
+def shard_worker_id(worker_id: int, shard: int, shards: int) -> int:
+    """The shard↔worker-namespace mapping: shard `shard` of worker
+    `worker_id` in an `shards`-wide campaign owns namespace
+    `worker_id*shards + shard`. Collapses to `worker_id` at shards=1
+    (the bit-identity case), keeps groups disjoint, and inherits the
+    WORKER_SEED_STRIDE contract: seed spaces stay collision-free while
+    workers*shards <= 64 per base_seed (shard bigger fleets across
+    base_seeds, exactly like workers)."""
+    return worker_id * shards + shard
+
+
+def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
+                 = None, devices=None, max_rounds: int = 16,
+                 dry_rounds: int = 3, base_seed: int = 0, chunk: int = 512,
+                 pipeline: bool = True, fused: bool = True,
+                 dup_slots: int = 2, havoc: int = 3,
+                 fresh_frac: float = 0.125, rng_seed: int = 0,
+                 observer=None, minimize: bool = False,
+                 div_bonus: float | None = None, merge_every: int = 1,
+                 corpus_dir: str | None = None, worker_id: int = 0,
+                 sync_every: int = 1, verify_resume: bool | None = None):
+    """Coverage-guided schedule fuzzing, sharded across a device mesh.
+
+    `batch` is PER SHARD: a round runs `shards*batch` lanes as one SPMD
+    dispatch, so throughput scales with the mesh while every shard's
+    search loop keeps `fuzz()`'s exact shape. `shards` defaults to every
+    local device (pass `devices` to pin a subset; the mesh is 1-D over
+    `devices[:shards]`). `merge_every` sets the cross-shard exchange
+    cadence in rounds (coverage entries + consensus fold); dry-stop and
+    campaign totals are always judged on the GLOBAL coverage frontier
+    (the all-gathered digest), so a late merge can delay sharing, never
+    coverage accounting. Durable campaigns (`corpus_dir=`) merge at
+    every sync point instead (`sync_every` — the persisted group state
+    must be post-merge so a resume restores what the shards knew);
+    `verify_resume` adds the run-twice guard on the first post-resume
+    round (see `fuzz()`).
+
+    Returns `fuzz()`'s result schema plus:
+      shards        the mesh width
+      per_shard     [{shard, worker_id, corpus_size, coverage, crashes,
+                     seeds_run}] — one row per shard, the view
+                    ProgressObserver renders per round
+    Other args match `fuzz()`. Randomness: shard s's corpus scheduler
+    draws from rng_seed+s, while the mutation master is fuzz()'s exact
+    formula (one key per round, split over all S*B lanes) — at shards=1
+    both collapse to `fuzz(rng_seed=rng_seed)`'s streams exactly.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shards is None:
+        shards = len(devices)
+    if shards > len(devices):
+        raise ValueError(f"shards={shards} > available devices "
+                         f"({len(devices)}) — grow the mesh (e.g. "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=N on CPU) or lower shards")
+    devices = list(devices)[:shards]
+    mesh = seed_mesh(devices)
+    S = shards
+    plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
+    eff_w = [shard_worker_id(worker_id, s, S) for s in range(S)]
+    # ONE mutation master, fuzz()'s exact formula: the global per-round
+    # key splits over all S*B lanes, so shards draw distinct mutations
+    # by lane position and the 1-shard stream equals fuzz()'s
+    master = jax.random.PRNGKey(np.uint32(rng_seed ^ 0x5EED5EED))
+    op_hist = np.zeros(N_MUT_OPS, np.int64)
+    if verify_resume is None:
+        verify_resume = _env_verify_resume()
+
+    stores = buckets = None
+    tally = None
+    round_start = 0
+    dry = 0
+    wall_prior = 0.0
+    if corpus_dir is not None:
+        from ..service.buckets import CrashBuckets
+        from ..service.store import CorpusStore, store_signature
+        sig = store_signature(rt, plan)
+        # one store handle per shard: scan cursors and entry-write dedup
+        # are per-corpus state, exactly like one handle per worker
+        stores = [CorpusStore(corpus_dir, signature=sig) for _ in range(S)]
+        buckets = CrashBuckets(stores[0])
+        group = stores[0].load_shard_group_state(worker_id)
+        from ..service.store import StoreMismatch
+        if group and group.get("shards") != S:
+            raise StoreMismatch(
+                f"corpus dir holds a {group.get('shards')}-shard group "
+                f"state for worker {worker_id}; resuming with shards={S} "
+                "would remap every shard namespace — finish or discard "
+                "the old group first")
+        # the shard↔worker mapping numerically overlaps plain worker
+        # ids (group 0 at 2 shards owns namespaces 0 AND 1): refuse a
+        # namespace some OTHER owner's scheduler state already claims,
+        # before any entry file could collide
+        own = f"shard group g{worker_id}"
+        claimed = stores[0].claimed_namespaces()
+        for ns in eff_w:
+            owner = claimed.get(ns)
+            if owner is not None and owner != own:
+                raise StoreMismatch(
+                    f"namespace {ns} (shard {ns - eff_w[0]} of {own}) is "
+                    f"already owned by {owner} in this corpus dir — give "
+                    "every worker on one dir the same shards= and "
+                    "non-overlapping ids (worker_id*shards+s must be "
+                    "unique; see DESIGN §15)")
+        round_start = int(group.get("rounds_done", 0)) if group else 0
+        dry = int(group.get("dry", 0)) if group else 0
+        wall_prior = float(group.get("wall_s", 0.0)) if group else 0.0
+        if group and group.get("op_hist"):
+            op_hist[:] = np.asarray(group["op_hist"], np.int64)
+        shard_states = group.get("shard_states") if group else None
+        corpora = []
+        for s in range(S):
+            c = stores[s].load_corpus(
+                plan, worker_id=eff_w[s], rng_seed=rng_seed + s,
+                fresh_frac=fresh_frac,
+                div_bonus=1.0 if div_bonus is None else div_bonus,
+                state=(shard_states[s] if shard_states else None))
+            c.track_admissions = True
+            corpora.append(c)
+        if group and group.get("tally") is not None:
+            tally = [{int(v): int(c) for v, c in slot}
+                     for slot in group["tally"]]
+        merge_every = sync_every     # persisted state must be post-merge
+    else:
+        corpora = []
+        for s in range(S):
+            c = Corpus(plan, rng=np.random.default_rng(rng_seed + s),
+                       fresh_frac=fresh_frac, worker_id=eff_w[s],
+                       div_bonus=1.0 if div_bonus is None else div_bonus)
+            c.track_admissions = True
+            corpora.append(c)
+    if div_bonus is not None:
+        for c in corpora:
+            c.div_bonus = float(div_bonus)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    lane_sharding = NamedSharding(mesh, P(SEED_AXIS))
+
+    def launch(r):
+        """Schedule per-shard parents, derive the round's mutants as ONE
+        masked SPMD dispatch over the mesh-sharded knob batch, and run
+        the round fused — nothing here blocks (mutate/apply/run/digest
+        are all queued async)."""
+        seeds_np = []
+        parent_knobs = []
+        ids_list = []
+        mutated = []
+        for s in range(S):
+            lane0 = (base_seed + eff_w[s] * WORKER_SEED_STRIDE
+                     + r * batch) % (1 << 32)
+            seeds_np.append((np.arange(batch, dtype=np.uint64)
+                             + np.uint64(lane0)).astype(np.uint32))
+            if r == 0 or len(corpora[s]) == 0:
+                parent_knobs.append(plan.base_batch(batch))
+                ids_list.append(np.full(batch, -1, np.int64))
+                mutated.append(False)
+            else:
+                parents, ids_s = corpora[s].schedule(batch)
+                parent_knobs.append(parents)
+                ids_list.append(ids_s)
+                mutated.append(True)
+        seeds = np.concatenate(seeds_np)
+        ids = np.concatenate(ids_list)
+        # per-leaf device_put keeps the dict's key order (a pytree put
+        # would sort it, reordering entry-npz members vs fuzz()'s
+        # bootstrap rounds — bit-identity is checked down to store
+        # bytes); each leaf lands already sharded over the mesh
+        parents_global = {
+            k: jax.device_put(
+                np.concatenate([p[k] for p in parent_knobs]),
+                lane_sharding)
+            for k in parent_knobs[0]}
+        if any(mutated):
+            # one SPMD havoc dispatch for the whole mesh: bootstrap
+            # shards' lanes pass through unmutated via the mask (and
+            # never count in the histogram); the mutation math
+            # partitions over the lane axis — it never leaves each
+            # shard's device, and one executable serves the mesh width
+            mask = jax.device_put(
+                np.repeat(np.asarray(mutated, bool), batch),
+                lane_sharding)
+            knobs_dev, hist = plan.mutate_masked(
+                parents_global,
+                jax.random.fold_in(master, np.uint32(r)), mask,
+                havoc=havoc)
+        else:
+            knobs_dev, hist = parents_global, None
+        # init on the default device, then place lanes over the mesh
+        # BEFORE the knob write, so apply_knobs runs SPMD per shard
+        from ..parallel.mesh import shard_batch
+        state = shard_batch(rt.init_batch(seeds), mesh)
+        state = plan.apply(state, knobs_dev)
+        if fused:
+            # run_fused_sharded is the lane→shard dispatch plumbing;
+            # the state is already mesh-placed, so its device_put is a
+            # no-op re-placement and the round runs as one SPMD dispatch
+            state = rt.run_fused_sharded(state, max_steps, chunk,
+                                         mesh=mesh)
+        else:
+            state, _ = rt.run(state, max_steps, chunk)
+        # the all-gathered O(distinct) coverage digest (queued async):
+        # campaign-global dedup without shipping [S*B] hashes per round
+        pairs, n = stats.coverage_digest(state)
+        return seeds, ids, knobs_dev, hist, mutated, state, pairs, n
+
+    def harvest(launched):
+        """Block on one round. Per-shard corpora read their own [batch]
+        hash/crash/knob lanes (kilobytes per shard — the same bill
+        fuzz() pays); the global dedup reads only the digest prefix."""
+        seeds, ids, knobs_dev, hist, mutated, state, pairs, n = launched
+        knobs_host = {k: np.asarray(v) for k, v in knobs_dev.items()}
+        hashes = stats.sched_hash_u64(state)
+        digest = stats.digest_hashes(pairs, n)
+        sk = np.asarray(state.cov_sketch)
+        sketches = sk if sk.ndim == 2 and sk.shape[1] > 0 else None
+        if hist is not None:
+            op_hist[:] += np.asarray(hist)
+        return (seeds, ids, knobs_host, hashes, digest,
+                np.asarray(state.crashed), np.asarray(state.crash_code),
+                mutated, sketches, state)
+
+    def do_merge():
+        """The cross-shard exchange: admissions since the last merge
+        flow into every other shard (order-independent set union keyed
+        by coverage), then the consensus counters fold through one
+        tally — every shard leaves judging novelty against the whole
+        campaign's history."""
+        nonlocal tally
+        if S == 1:
+            corpora[0].admitted_unmerged.clear()
+            return
+        outboxes = [list(c.admitted_unmerged) for c in corpora]
+        for c in corpora:
+            c.admitted_unmerged.clear()
+        for s in range(S):
+            for t in range(S):
+                if t == s:
+                    continue
+                for e in outboxes[t]:
+                    corpora[s].admit_foreign(e)
+        tally = merge_consensus(corpora, tally)
+
+    def sync_group(rounds_done, dry_now, wall_s):
+        do_merge()
+        merged = 0
+        for s in range(S):
+            merged += stores[s].merge_foreign(corpora[s])
+            stores[s].persist_entries(corpora[s], eff_w[s])
+        stores[0].write_shard_group_state(
+            corpora, worker_id=worker_id, shards=S,
+            rounds_done=rounds_done, dry=dry_now, op_hist=op_hist,
+            wall_s=wall_s, tally=tally)
+        return merged
+
+    # global coverage frontier: on resume, the union of every shard's
+    # cumulative view — dry detection continues across resumes
+    seen: set[int] = set()
+    shard_seen: list[set[int]] = [set() for _ in range(S)]
+    if stores is not None:
+        for s in range(S):
+            keys = corpora[s].coverage_keys()
+            shard_seen[s] = keys
+            seen |= keys
+    crashes: dict[int, int] = {}
+    repros: dict[int, dict] = {}
+    opened_buckets: list[str] = []
+    n_crashed = 0
+    shard_crashes = [0] * S
+    # codes any shard already knows (restored crash_codes on a resume)
+    # are not news to a later round's record
+    seen_crash_codes: set[int] = set()
+    for c in corpora:
+        seen_crash_codes |= c.crash_codes
+    new_per_round: list[int] = []
+    rounds = 0
+    speculate = pipeline and fused and stores is None
+    t0 = time.perf_counter()
+    pending = (launch(round_start)
+               if round_start < max_rounds and dry < dry_rounds else None)
+    verify_round = (round_start if verify_resume and stores is not None
+                    and round_start > 0 else None)
+    for r in range(round_start, max_rounds):
+        if pending is None:
+            break
+        nxt = (launch(r + 1) if speculate and r + 1 < max_rounds else None)
+        harvested = harvest(pending)
+        if r == verify_round:
+            harvested = _verified_harvest(
+                rt, plan, harvested, harvest, max_steps, chunk, fused, mesh)
+        (seeds, ids, knobs_host, hashes, digest, crashed, codes,
+         mutated, sketches, state) = harvested
+        rounds += 1
+        corpus_size = 0
+        per_shard_rows = []
+        round_new_codes: list[int] = []
+        for s in range(S):
+            lo, hi = s * batch, (s + 1) * batch
+            sk_s = sketches[lo:hi] if sketches is not None else None
+            cstats = corpora[s].observe(
+                {k: v[lo:hi] for k, v in knobs_host.items()},
+                seeds[lo:hi], hashes[lo:hi], crashed[lo:hi], codes[lo:hi],
+                ids[lo:hi], r, sketches=sk_s)
+            shard_seen[s] |= set(hashes[lo:hi].tolist())
+            corpus_size += cstats["size"]
+            shard_crashes[s] += int(crashed[lo:hi].sum())
+            # campaign-level "new" means new to EVERY shard's view —
+            # a code one shard already knows is not news to the round
+            for c in cstats["new_crash_codes"]:
+                if c not in seen_crash_codes:
+                    seen_crash_codes.add(c)
+                    round_new_codes.append(c)
+            per_shard_rows.append(dict(
+                shard=s, worker_id=eff_w[s],
+                corpus_size=cstats["size"],
+                coverage=len(shard_seen[s]),
+                new=cstats["new"],
+                crashes=int(crashed[lo:hi].sum()),
+                seeds_run=rounds * batch))
+        for i in np.nonzero(crashed)[0]:
+            c = int(codes[i])
+            if not mutated[int(i) // batch]:
+                crashes.setdefault(c, int(seeds[i]))
+            if c not in repros:
+                kn = KnobPlan.lane(knobs_host, int(i))
+                repros[c] = dict(seed=int(seeds[i]), round=r, knobs=kn,
+                                 script=plan.to_scenario(kn).describe())
+        if buckets is not None and crashed.any():
+            coded: set[int] = set()
+            for i in np.nonzero(crashed)[0]:
+                c = int(codes[i])
+                if c in coded:
+                    continue
+                coded.add(c)
+                key, opened = buckets.observe_lane(
+                    state, int(i), seed=int(seeds[i]),
+                    knobs=KnobPlan.lane(knobs_host, int(i)),
+                    round_no=r, worker_id=eff_w[int(i) // batch])
+                if opened:
+                    opened_buckets.append(key)
+        n_crashed += int(crashed.sum())
+        fresh = set(digest.tolist()) - seen
+        seen |= fresh
+        new_per_round.append(len(fresh))
+        dry = dry + 1 if not fresh else 0
+        if observer is not None:
+            rec = dict(
+                kind="fuzz_round", round=rounds, batch=batch, shards=S,
+                seeds_run=rounds * batch * S, new_schedules=len(fresh),
+                distinct_total=len(seen), crashes=n_crashed,
+                corpus_size=corpus_size,
+                new_crash_codes=round_new_codes,
+                per_shard=per_shard_rows,
+                dry_rounds=dry, wall_s=time.perf_counter() - t0)
+            if buckets is not None:
+                rec["buckets_opened"] = len(opened_buckets)
+            if sketches is not None:
+                # round-level divergence depth off the on-device
+                # consensus all-reduce — the mesh's modal prefix, not a
+                # host re-computation over [S*B] lanes
+                modal = stats.consensus_allreduce(state.cov_sketch)
+                rec["div_slot_p50"] = int(np.median(
+                    stats.first_divergence_slots(sketches,
+                                                 consensus=modal)))
+            observer.on_round(rec)
+        at_merge = (r + 1 - round_start) % merge_every == 0
+        stopping = dry >= dry_rounds or r + 1 == max_rounds
+        if stores is not None and (at_merge or stopping):
+            sync_group(r + 1, dry,
+                       wall_prior + time.perf_counter() - t0)
+        elif stores is None and (at_merge or stopping):
+            do_merge()
+        if dry >= dry_rounds:
+            break
+        pending = nxt if nxt is not None else (
+            launch(r + 1) if r + 1 < max_rounds else None)
+
+    result = dict(
+        seeds_run=rounds * batch * S,
+        rounds=rounds,
+        shards=S,
+        distinct_schedules=len(seen),
+        new_per_round=new_per_round,
+        saturated=dry >= dry_rounds,
+        crash_first_seed_by_code=crashes,
+        crashes=n_crashed,
+        crash_repros=repros,
+        corpus_size=sum(len(c) for c in corpora),
+        per_shard=[dict(shard=s, worker_id=eff_w[s],
+                        corpus_size=len(corpora[s]),
+                        coverage=len(shard_seen[s]),
+                        crashes=shard_crashes[s],
+                        seeds_run=rounds * batch)
+                   for s in range(S)],
+        mutation_ops={OP_NAMES[i]: int(op_hist[i])
+                      for i in range(N_MUT_OPS)},
+    )
+    if stores is not None:
+        result.update(
+            corpus_dir=stores[0].dir,
+            rounds_done_total=round_start + rounds,
+            buckets_opened=opened_buckets,
+            buckets_total=len(stores[0].bucket_keys()))
+    if minimize and repros:
+        from ..harness.minimize import minimize_knobs
+        result["minimized"] = {}
+        for c, rep in repros.items():
+            try:
+                minimal, info = minimize_knobs(rt, plan, rep["knobs"],
+                                               rep["seed"], max_steps,
+                                               chunk)
+                result["minimized"][c] = dict(info, knobs=minimal)
+            except Exception as e:  # noqa: BLE001 - repro handle still stands
+                result["minimized"][c] = dict(error=f"{type(e).__name__}: {e}")
+        if buckets is not None:
+            # attach the shrunk fault script to buckets this run opened
+            # (matched by crash code — same reporting contract as fuzz())
+            for key in buckets.new_keys:
+                rec_b = stores[0].load_bucket(key)
+                mini = result["minimized"].get(int(rec_b["crash_code"]))
+                if mini and "script" in mini:
+                    rec_b["minimized"] = {
+                        k: v for k, v in mini.items() if k != "knobs"}
+                    stores[0].write_bucket(key, rec_b)
+    if observer is not None:
+        observer.on_done(dict(
+            kind="done", distinct_total=len(seen),
+            wall_s=time.perf_counter() - t0,
+            **{k: v for k, v in result.items()
+               if k not in ("crash_repros", "minimized", "per_shard")}))
+    return result
+
+
+def _verified_harvest(rt, plan, harvested, harvest_fn, max_steps, chunk,
+                      fused, mesh):
+    """The run-twice resume guard (knob-gated, see fuzz(verify_resume=)):
+    re-dispatch the SAME (seeds, knobs) batch until two consecutive
+    invocations agree on the authoritative outputs
+    (utils.verify.agree_twice — a resumed campaign's first fused
+    invocation is exactly the deserialized-executable case of the
+    persistent-cache transient; real nondeterminism raises)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..utils.verify import agree_twice
+
+    def key_of(h):
+        _, _, _, hashes, digest, crashed, codes, _, sketches, _ = h
+        return (hashes.tobytes(), crashed.tobytes(), codes.tobytes(),
+                None if sketches is None else sketches.tobytes())
+
+    def again(prev):
+        # prev is a HARVESTED tuple: (seeds, ids, knobs_host, hashes,
+        # digest, crashed, codes, mutated, sketches, state). The knob
+        # batch was never donated, so re-placing the host copy over the
+        # mesh re-dispatches the identical round.
+        seeds, ids, knobs_host, mutated = prev[0], prev[1], prev[2], prev[7]
+        sharding = NamedSharding(mesh, P(SEED_AXIS))
+        knobs_dev = {k: jax.device_put(v, sharding)
+                     for k, v in knobs_host.items()}
+        from ..parallel.mesh import shard_batch
+        state = plan.apply(shard_batch(rt.init_batch(seeds), mesh),
+                           knobs_dev)
+        if fused:
+            # already mesh-placed; run_fused_sharded's device_put is a
+            # no-op re-placement
+            state = rt.run_fused_sharded(state, max_steps, chunk,
+                                         mesh=mesh)
+        else:
+            state, _ = rt.run(state, max_steps, chunk)
+        pairs, n = stats.coverage_digest(state)
+        return harvest_fn((seeds, ids, knobs_dev, None,
+                           mutated, state, pairs, n))
+
+    return agree_twice(harvested, again, key_of,
+                       what="first post-resume campaign round")

@@ -37,10 +37,15 @@ def worker_cmd(corpus_dir: str, worker_id: int, factory: str, *,
                batch: int = 64, max_rounds: int = 4, chunk: int = 256,
                dry_rounds: int | None = None, base_seed: int = 0,
                sync_every: int = 1, minimize: bool = False,
+               shards: int = 1, verify_resume: bool = False,
                python: str = sys.executable) -> list[str]:
     """The argv for one campaign worker process. `factory` is a
     "module:function" spec resolved in the worker (the runtime itself
-    is not picklable across processes — a factory is the contract)."""
+    is not picklable across processes — a factory is the contract).
+    `shards` > 1 makes the worker drive a mesh-sharded campaign
+    (search/shard.py — the worker forces a wide-enough CPU mesh when
+    the platform is cpu); `verify_resume` arms the run-twice guard on
+    its first post-resume round."""
     cmd = [python, "-m", "madsim_tpu.service.worker",
            "--corpus-dir", corpus_dir,
            "--worker-id", str(worker_id),
@@ -57,6 +62,10 @@ def worker_cmd(corpus_dir: str, worker_id: int, factory: str, *,
         cmd += ["--dry-rounds", str(dry_rounds)]
     if minimize:
         cmd += ["--minimize"]
+    if shards != 1:
+        cmd += ["--shards", str(shards)]
+    if verify_resume:
+        cmd += ["--verify-resume"]
     return cmd
 
 
@@ -73,6 +82,18 @@ def spawn_worker(corpus_dir: str, worker_id: int, factory: str,
     # first cold worker compiles, the rest replay the executable
     e.setdefault("JAX_COMPILATION_CACHE_DIR",
                  os.path.join(os.path.abspath(corpus_dir), ".jax_cache"))
+    # a mesh-sharded worker needs its virtual CPU devices before jax
+    # initializes — the flag in the child env is the robust path (the
+    # worker's in-process fallback only fires when it is absent).
+    # Unconditional on platform: the flag only sizes the HOST (cpu)
+    # backend, so on an accelerator host it is inert and the mesh spans
+    # the real devices
+    if kw.get("shards", 1) > 1 \
+            and "xla_force_host_platform_device_count" \
+            not in e.get("XLA_FLAGS", ""):
+        e["XLA_FLAGS"] = (e.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count="
+                          + str(kw["shards"])).strip()
     return subprocess.Popen(
         worker_cmd(corpus_dir, worker_id, factory, **kw), env=e,
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
@@ -92,6 +113,10 @@ def campaign_stats(corpus_dir: str, *, uptime_s: float = 0.0,
         store = CorpusStore(corpus_dir, create=False)
     coverage = store.coverage_keys()
     states = [store.load_worker_state(w) for w in store.worker_ids()]
+    # mesh-sharded groups (r13) roll up next to plain workers: their
+    # group json carries the same top-level rounds_done/wall_s
+    states += [store.load_shard_group_state(g)
+               for g in store.shard_group_ids()]
     wall = max([s.get("wall_s", 0.0) for s in states], default=0.0)
     rounds_done = sum(s.get("rounds_done", 0) for s in states)
     buckets = store.bucket_keys()
@@ -114,21 +139,28 @@ def run_campaign(factory: str, corpus_dir: str, *, workers: int = 2,
                  max_steps: int, batch: int = 64, max_rounds: int = 4,
                  chunk: int = 256, factory_kwargs: dict | None = None,
                  base_seed: int = 0, sync_every: int = 1,
-                 minimize: bool = False, observer=None,
+                 minimize: bool = False, shards: int = 1,
+                 verify_resume: bool = False, observer=None,
                  env: dict | None = None, poll_s: float = 2.0,
                  python: str = sys.executable) -> dict:
     """Run one campaign segment: spawn `workers` processes on one corpus
     dir, poll campaign stats while they run, and return the merged
     report. Re-running with the same arguments RESUMES the campaign
     (each worker picks up at its rounds_done) — an always-on service is
-    this call in a loop with a growing `max_rounds`."""
+    `supervise_campaign` (this call in a loop with a growing
+    `max_rounds`, dead-worker restarts, and cold-entry pruning).
+    `shards` > 1 makes every worker a mesh-sharded campaign of that
+    width (search/shard.py): the two scale axes compose — processes
+    multiply meshes, and all namespaces stay disjoint by the
+    worker_id*shards+s mapping."""
     t0 = time.monotonic()
     procs = {
         w: spawn_worker(corpus_dir, w, factory,
                         factory_kwargs=factory_kwargs, max_steps=max_steps,
                         batch=batch, max_rounds=max_rounds, chunk=chunk,
                         base_seed=base_seed, sync_every=sync_every,
-                        minimize=minimize, env=env, python=python)
+                        minimize=minimize, shards=shards,
+                        verify_resume=verify_resume, env=env, python=python)
         for w in range(workers)}
     results = {}
     poll = 0
@@ -185,37 +217,198 @@ def campaign_report(corpus_dir: str, uptime_s: float = 0.0,
                  minimized="minimized" in m)
             for m in merged],
         workers_detail={
-            w: dict(rounds_done=s.get("rounds_done", 0),
-                    corpus_entries=len(s.get("order", [])),
-                    wall_s=round(s.get("wall_s", 0.0), 2),
-                    dry=s.get("dry", 0))
-            for w, s in per_worker.items()},
+            **{w: dict(rounds_done=s.get("rounds_done", 0),
+                       corpus_entries=len(s.get("order", [])),
+                       wall_s=round(s.get("wall_s", 0.0), 2),
+                       dry=s.get("dry", 0))
+               for w, s in per_worker.items()},
+            # mesh-sharded groups: one row per group, shard widths and
+            # the per-shard live-entry split visible
+            **{f"g{g}": dict(
+                rounds_done=s.get("rounds_done", 0),
+                shards=s.get("shards", 0),
+                corpus_entries=sum(len(sh.get("order", []))
+                                   for sh in s.get("shard_states", [])),
+                per_shard_entries=[len(sh.get("order", []))
+                                   for sh in s.get("shard_states", [])],
+                wall_s=round(s.get("wall_s", 0.0), 2),
+                dry=s.get("dry", 0))
+               for g, s in ((g, store.load_shard_group_state(g))
+                            for g in store.shard_group_ids())}},
         worker_results=worker_results)
 
 
+def prune_cold_entries(corpus_dir: str, below: float = 0.1,
+                       keep_min: int = 4) -> dict:
+    """Supervisor policy op: drop cold entries (current energy < `below`)
+    from every worker's and shard's LIVE corpus, keeping at least the
+    `keep_min` hottest per corpus. Rewrites only the scheduler `order`
+    lists (one atomic replace per state file); entry FILES are immutable
+    admission records and stay — the campaign's coverage frontier
+    (`_seen`, dedup, dry detection) is untouched, exactly like an
+    eviction. Run it only between segments (no live workers): a pruned
+    order changes the resumed run's parent draws BY DESIGN — this is a
+    supervisor intervention, not a resume, so the split==continuous
+    equality contract deliberately does not span it.
+
+    Returns {pruned, kept, workers} counts."""
+    from .store import _atomic_json
+    store = CorpusStore(corpus_dir, create=False)
+    pruned = kept = touched = 0
+
+    def prune_order(order):
+        nonlocal pruned, kept
+        if len(order) <= keep_min:
+            kept += len(order)
+            return order, False
+        hot = sorted(range(len(order)), key=lambda i: -order[i][1])
+        protect = set(hot[:keep_min])
+        new = [row for i, row in enumerate(order)
+               if row[1] >= below or i in protect]
+        pruned += len(order) - len(new)
+        kept += len(new)
+        return new, len(new) != len(order)
+
+    for w in store.worker_ids():
+        ws = store.load_worker_state(w)
+        if not ws:
+            continue
+        ws["order"], changed = prune_order(ws.get("order", []))
+        if changed:
+            _atomic_json(store.worker_state_path(w), ws)
+            touched += 1
+    for g in store.shard_group_ids():
+        gs = store.load_shard_group_state(g)
+        changed_any = False
+        for sh in gs.get("shard_states", []):
+            sh["order"], changed = prune_order(sh.get("order", []))
+            changed_any |= changed
+        if changed_any:
+            _atomic_json(store.shard_group_path(g), gs)
+            touched += 1
+    return dict(pruned=pruned, kept=kept, workers=touched)
+
+
+def supervise_campaign(factory: str, corpus_dir: str, *, workers: int = 2,
+                       segments: int = 3, rounds_per_segment: int = 4,
+                       max_steps: int, batch: int = 64, chunk: int = 256,
+                       factory_kwargs: dict | None = None,
+                       base_seed: int = 0, sync_every: int = 1,
+                       minimize: bool = False, shards: int = 1,
+                       verify_resume: bool = False,
+                       prune_below: float = 0.1, prune_keep_min: int = 4,
+                       observer=None, env: dict | None = None,
+                       poll_s: float = 2.0,
+                       python: str = sys.executable,
+                       run_segment=None) -> dict:
+    """The always-on supervisor loop (the r11 follow-on): run campaign
+    SEGMENTS back to back, each rotating the per-worker `max_rounds`
+    target up by `rounds_per_segment` — so `run_campaign`'s
+    one-segment-per-call contract becomes a service. Between segments
+    the supervisor:
+
+      - RESTARTS dead workers: a worker that exited nonzero (crash,
+        OOM, SIGKILL) left its store consistent at its last sync; the
+        next segment respawns every worker id, and the dead one resumes
+        from where it actually synced (the durability contract) — the
+        restart count is reported per segment;
+      - PRUNES cold corpus entries (`prune_cold_entries`): energies
+        decay every round, so multi-segment campaigns accumulate dead
+        weight in the scheduler orders; pruning keeps parent sampling
+        sharp without ever forgetting coverage.
+
+    `run_segment` injects the segment runner (tests stub it); default
+    is `run_campaign`. Returns {segments: [per-segment report summary],
+    restarts, pruned, report: final merged campaign_report}."""
+    runner = run_campaign if run_segment is None else run_segment
+    seg_rows = []
+    restarts = 0
+    pruned_total = 0
+    for seg in range(segments):
+        target = (seg + 1) * rounds_per_segment
+        rep = runner(factory, corpus_dir, workers=workers,
+                     max_steps=max_steps, batch=batch, max_rounds=target,
+                     chunk=chunk, factory_kwargs=factory_kwargs,
+                     base_seed=base_seed, sync_every=sync_every,
+                     minimize=minimize, shards=shards,
+                     verify_resume=verify_resume, observer=observer,
+                     env=env, poll_s=poll_s, python=python)
+        dead = sorted(
+            int(w) for w, r in (rep.get("worker_results") or {}).items()
+            if r.get("returncode") not in (0, None))
+        if seg + 1 < segments:
+            restarts += len(dead)
+            pr = prune_cold_entries(corpus_dir, below=prune_below,
+                                    keep_min=prune_keep_min)
+            pruned_total += pr["pruned"]
+        seg_rows.append(dict(
+            segment=seg, max_rounds=target,
+            rounds_done=rep.get("rounds_done", 0),
+            coverage_keys=rep.get("coverage_keys", 0),
+            buckets=rep.get("buckets", 0),
+            dead_workers=dead))
+        if observer is not None:
+            observer.on_round(dict(kind="supervisor", segment=seg,
+                                   max_rounds=target,
+                                   dead_workers=dead,
+                                   restarts=restarts,
+                                   pruned=pruned_total))
+    return dict(segments=seg_rows, restarts=restarts,
+                pruned=pruned_total,
+                report=campaign_report(corpus_dir, workers=workers))
+
+
 def replay_bucket(rt, corpus_dir: str, key: str, max_steps: int,
-                  chunk: int = 256, dup_slots: int = 2):
+                  chunk: int = 256, dup_slots: int = 2,
+                  verify: bool | None = None):
     """Re-run a bucket's kept repro — the durable analog of pasting a
     madsim seed into a failing test. Returns (crashed, crash_code,
     explain dict or None): the (seed, knobs) handle replays the exact
     trajectory on any host with a structurally equal runtime — the
     manifest signature guards that (a mismatched `rt`, or a different
     `dup_slots` than the campaign fuzzed with, raises StoreMismatch
-    here instead of replaying knobs onto the wrong rows)."""
+    here instead of replaying knobs onto the wrong rows).
+
+    verify (r13, knob-gated; None reads MADSIM_FUZZ_VERIFY_RESUME,
+    default off): run-twice guard mirroring `analyze.replay_race` — a
+    bucket replay is replay-AUTHORITATIVE ("does this bug still
+    exist?"), and this jaxlib's first invocation of a fused executable
+    deserialized from the shared persistent compile cache can return a
+    deterministic-but-wrong result under load (ROADMAP r12 note;
+    campaign workers share one cache dir by design). With verify on,
+    the lane re-runs until two consecutive invocations agree on
+    (crashed, code, fingerprint); three distinct results raise — real
+    nondeterminism, not the known transient."""
     import numpy as np
 
     from ..obs.causal import explain_crash
+    from ..search.fuzz import _env_verify_resume
     from ..search.mutate import KnobPlan
     from .store import store_signature
+    if verify is None:
+        verify = _env_verify_resume()
     plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
     store = CorpusStore(corpus_dir, signature=store_signature(rt, plan),
                         create=False)
     seed, knobs = store.load_bucket_repro(key)
-    state = plan.apply(rt.init_batch(np.asarray([seed], np.uint32)),
-                       KnobPlan.stack([knobs]))
-    state = rt.run_fused(state, max_steps, chunk)
-    crashed = bool(np.asarray(state.crashed)[0])
-    code = int(np.asarray(state.crash_code)[0])
+
+    def once():
+        state = plan.apply(rt.init_batch(np.asarray([seed], np.uint32)),
+                           KnobPlan.stack([knobs]))
+        state = rt.run_fused(state, max_steps, chunk)
+        return state, (bool(np.asarray(state.crashed)[0]),
+                       int(np.asarray(state.crash_code)[0]),
+                       int(rt.fingerprints(state)[0]))
+
+    state, out = once()
+    if verify:
+        from ..utils.verify import agree_twice
+        state, out = agree_twice(
+            (state, out), lambda _: once(), key_of=lambda t: t[1],
+            what=f"bucket {key}",
+            detail=lambda a, b, c: (f"fingerprints {a[1][2]}, {b[1][2]}, "
+                                    f"{c[1][2]}"))
+    crashed, code, _ = out
     exp = None
     if crashed and rt.cfg.trace_cap > 0:
         exp = explain_crash(state, 0)

@@ -28,6 +28,17 @@ Layout (one campaign = one directory):
                           cross-round consensus sketch counters — energy
                           and rng are per-worker POLICY state; coverage
                           (the entry files) is the shared campaign truth
+  state/g<w>.json         a MESH-SHARDED worker's group state (r13):
+                          every shard's scheduler state plus the
+                          cross-shard consensus tally, committed by ONE
+                          rename per sync so a kill can never tear the
+                          shards of one worker apart (shard s mints
+                          entries in namespace w*shards+s — just more
+                          worker ids to everyone else; because that
+                          mapping numerically overlaps plain worker
+                          ids, fuzz/fuzz_sharded refuse at open any
+                          namespace another owner's state claims —
+                          `claimed_namespaces`)
   buckets/<key>.json|.npz|.trace.json
                           crash buckets (service/buckets.py): fingerprint
                           record, minimal (seed, knobs) repro, Perfetto
@@ -214,6 +225,16 @@ class CorpusStore:
     def worker_state_path(self, worker_id: int) -> str:
         return os.path.join(self.state_dir, f"w{worker_id:04d}.json")
 
+    def shard_group_path(self, worker_id: int) -> str:
+        """A mesh-sharded worker's GROUP state (r13, search/shard.py):
+        all of its shards' scheduler states in one file, written by one
+        atomic rename — a SIGKILL can never tear the shards of one
+        worker apart. Named g<id>, not w<id>, so a plain fuzz() worker
+        scanning the dir never mistakes a group file for its own state
+        (the shard namespaces are disjoint from base worker ids by the
+        worker_id*shards+s mapping, so entries can't collide either)."""
+        return os.path.join(self.state_dir, f"g{worker_id:04d}.json")
+
     def worker_log_path(self, worker_id: int) -> str:
         return os.path.join(self.logs_dir, f"w{worker_id:04d}.jsonl")
 
@@ -263,6 +284,21 @@ class CorpusStore:
         with open(p) as f:
             return json.load(f)
 
+    @staticmethod
+    def _scheduler_state(corpus: Corpus) -> dict:
+        """One corpus's serialized scheduler state — the per-worker (or
+        per-shard) half of a state file: live-entry order + CURRENT
+        energies, namespace counter, rng, consensus counters."""
+        return dict(
+            next_counter=split_entry_id(corpus._next_id)[1],
+            order=[[int(e["id"]), float(e["energy"])]
+                   for e in corpus.entries],
+            crash_codes=sorted(int(c) for c in corpus.crash_codes),
+            sketch_counts=(None if corpus._slot_counts is None else
+                           [sorted((int(v), int(c)) for v, c in s.items())
+                            for s in corpus._slot_counts]),
+            rng_state=corpus.rng.bit_generator.state)
+
     def write_worker_state(self, corpus: Corpus, worker_id: int,
                            rounds_done: int, dry: int, op_hist,
                            wall_s: float) -> None:
@@ -273,14 +309,45 @@ class CorpusStore:
             dry=int(dry),
             wall_s=float(wall_s),
             op_hist=[int(x) for x in np.asarray(op_hist)],
-            next_counter=split_entry_id(corpus._next_id)[1],
-            order=[[int(e["id"]), float(e["energy"])]
-                   for e in corpus.entries],
-            crash_codes=sorted(int(c) for c in corpus.crash_codes),
-            sketch_counts=(None if corpus._slot_counts is None else
-                           [sorted((int(v), int(c)) for v, c in s.items())
-                            for s in corpus._slot_counts]),
-            rng_state=corpus.rng.bit_generator.state))
+            **self._scheduler_state(corpus)))
+
+    def write_shard_group_state(self, corpora, worker_id: int, shards: int,
+                                rounds_done: int, dry: int, op_hist,
+                                wall_s: float, tally=None) -> None:
+        """Persist a sharded worker's WHOLE group as one atomic write:
+        per-shard scheduler states (namespaced worker_id*shards+s), the
+        shared round/dry/wall counters, and the cross-shard consensus
+        tally. Top-level rounds_done/wall_s keep campaign_stats readers
+        working unchanged. Entry files must already be on disk
+        (`persist_entries` per shard) — the group json is the commit
+        point, exactly like a worker state."""
+        _atomic_json(self.shard_group_path(worker_id), dict(
+            worker_id=int(worker_id),
+            shards=int(shards),
+            rounds_done=int(rounds_done),
+            dry=int(dry),
+            wall_s=float(wall_s),
+            op_hist=[int(x) for x in np.asarray(op_hist)],
+            tally=(None if tally is None else
+                   [sorted((int(v), int(c)) for v, c in s.items())
+                    for s in tally]),
+            shard_states=[
+                dict(worker_id=int(c.worker_id),
+                     **self._scheduler_state(c))
+                for c in corpora]))
+
+    def load_shard_group_state(self, worker_id: int) -> dict:
+        p = self.shard_group_path(worker_id)
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def persist_entries(self, corpus: Corpus, worker_id: int) -> None:
+        """Write this corpus's not-yet-persisted own-namespace
+        admissions (the public entry-file half of a sync; the sharded
+        driver commits the group state separately, in one write)."""
+        self._write_own_entries(corpus, worker_id)
 
     def _write_own_entries(self, corpus: Corpus, worker_id: int) -> None:
         """Write any of this worker's admissions not yet on disk (ids in
@@ -299,16 +366,18 @@ class CorpusStore:
 
     # -- corpus load / merge -------------------------------------------
     def load_corpus(self, plan, worker_id: int = 0, rng_seed: int = 0,
-                    **corpus_kwargs) -> Corpus:
+                    state: dict | None = None, **corpus_kwargs) -> Corpus:
         """Rebuild this worker's corpus: its own scheduler state (entry
         order, current energies, rng, consensus counters) from the state
         json, its own coverage history from its entry files, and every
         OTHER worker's entries merged in (`admit_foreign`). A fresh dir
-        returns a fresh corpus seeded with `rng_seed`."""
+        returns a fresh corpus seeded with `rng_seed`. `state` overrides
+        the on-disk worker json — the sharded driver passes one shard's
+        slice of a group state (the shards share a file, not a schema)."""
         corpus = Corpus(plan, rng=np.random.default_rng(rng_seed),
                         worker_id=worker_id, **corpus_kwargs)
         corpus.track_evictions = True
-        ws = self.load_worker_state(worker_id)
+        ws = self.load_worker_state(worker_id) if state is None else state
         order = ws.get("order", [])
         if ws:
             corpus.rng.bit_generator.state = ws["rng_state"]
@@ -379,6 +448,38 @@ class CorpusStore:
         out = []
         for n in sorted(os.listdir(self.state_dir)):
             if n.startswith("w") and n.endswith(".json") \
+                    and not _is_tmp(n):
+                out.append(int(n[1:-5]))
+        return out
+
+    def claimed_namespaces(self) -> dict:
+        """{worker-id namespace: owner label} for every namespace with
+        scheduler state in this dir: a plain worker owns its own id, a
+        shard group owns worker_id*shards+s for each of its shards.
+        The shard↔worker mapping means a group's namespaces NUMERICALLY
+        overlap plain worker ids (group 0 at 2 shards owns 0 and 1), so
+        mixing plain and sharded workers carelessly on one dir would
+        mint colliding entry files; fuzz()/fuzz_sharded() consult this
+        map at open and refuse a namespace another owner already
+        claimed. Best-effort (a check, not a lock): two workers racing
+        their FIRST sync can still pass — the guard is for the
+        misconfiguration, which persists, not the race window."""
+        out = {}
+        for w in self.worker_ids():
+            out[w] = f"worker w{w}"
+        for g in self.shard_group_ids():
+            gs = self.load_shard_group_state(g)
+            for sh in gs.get("shard_states", []):
+                out[int(sh["worker_id"])] = f"shard group g{g}"
+        return out
+
+    def shard_group_ids(self) -> list[int]:
+        """Base worker ids of mesh-sharded groups syncing into this dir
+        (their g<id>.json files; campaign_stats folds these into the
+        rollup next to plain worker states)."""
+        out = []
+        for n in sorted(os.listdir(self.state_dir)):
+            if n.startswith("g") and n.endswith(".json") \
                     and not _is_tmp(n):
                 out.append(int(n[1:-5]))
         return out

@@ -21,6 +21,34 @@ import os
 import sys
 
 
+def _force_cpu_mesh(n: int, argv=None) -> None:
+    """Grow the host platform to >= n virtual devices for a mesh-sharded
+    worker (--shards). A spawner that already put
+    xla_force_host_platform_device_count in XLA_FLAGS
+    (service/campaign.py does) wins outright — newer jaxlibs REJECT
+    having both that flag and jax_num_cpu_devices set, so the config
+    option is only tried when the flag is absent. On jaxlibs without
+    jax_num_cpu_devices the flag is the only mechanism, and XLA parses
+    it at library load — long past by the time `-m` has imported the
+    package — so the fallback RE-EXECS this worker once with the flag
+    in its env (idempotent: the re-exec'd process sees the flag and
+    returns here immediately). Harmless on accelerator hosts either
+    way: both knobs only size the HOST (cpu) backend."""
+    if "xla_force_host_platform_device_count" in os.environ.get(
+            "XLA_FLAGS", ""):
+        return
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+        os.execv(sys.executable,
+                 [sys.executable, "-m", "madsim_tpu.service.worker"]
+                 + list(argv if argv is not None else sys.argv[1:]))
+
+
 def resolve_factory(spec: str):
     mod, _, fn = spec.partition(":")
     if not fn:
@@ -50,9 +78,23 @@ def main(argv=None) -> int:
                     help="corpus/mutation randomness (default: worker id)")
     ap.add_argument("--sync-every", type=int, default=1)
     ap.add_argument("--minimize", action="store_true")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="mesh-shard this worker's campaign across N "
+                         "devices (search/shard.py); shard namespaces "
+                         "are worker_id*shards+s")
+    ap.add_argument("--verify-resume", action="store_true",
+                    help="run-twice guard on the first post-resume "
+                         "round (the persistent-cache first-invocation "
+                         "transient, ROADMAP r12)")
     ap.add_argument("--progress", action="store_true",
                     help="render live rounds on stderr too")
     args = ap.parse_args(argv)
+
+    if args.shards > 1:
+        # unconditional on platform: this only sizes the HOST (cpu)
+        # backend's virtual device count — inert when an accelerator is
+        # the default platform, required when the worker lands on CPU
+        _force_cpu_mesh(args.shards, argv)
 
     # all workers of a campaign share one persistent compile cache (r8):
     # honor an inherited JAX_COMPILATION_CACHE_DIR, else keep it inside
@@ -78,19 +120,32 @@ def main(argv=None) -> int:
         obs = TeeObserver(obs, ProgressObserver())
     dry = (args.dry_rounds if args.dry_rounds is not None
            else args.max_rounds + 1)
-    res = fuzz(rt, max_steps=args.max_steps, batch=args.batch,
-               max_rounds=args.max_rounds, dry_rounds=dry,
-               base_seed=args.base_seed, chunk=args.chunk,
-               rng_seed=(args.rng_seed if args.rng_seed is not None
-                         else args.worker_id),
-               observer=obs, minimize=args.minimize,
-               corpus_dir=args.corpus_dir, worker_id=args.worker_id,
-               sync_every=args.sync_every)
+    kw = dict(max_steps=args.max_steps, batch=args.batch,
+              max_rounds=args.max_rounds, dry_rounds=dry,
+              base_seed=args.base_seed, chunk=args.chunk,
+              observer=obs, minimize=args.minimize,
+              corpus_dir=args.corpus_dir, worker_id=args.worker_id,
+              sync_every=args.sync_every,
+              verify_resume=args.verify_resume or None)
+    if args.shards > 1:
+        from ..search.shard import fuzz_sharded
+        # default rng spacing worker_id*shards: shard s of worker w
+        # draws with rng_seed w*shards+s — groups stay disjoint exactly
+        # like their namespaces
+        res = fuzz_sharded(rt, shards=args.shards,
+                           rng_seed=(args.rng_seed
+                                     if args.rng_seed is not None
+                                     else args.worker_id * args.shards),
+                           **kw)
+    else:
+        res = fuzz(rt,
+                   rng_seed=(args.rng_seed if args.rng_seed is not None
+                             else args.worker_id), **kw)
     print(json.dumps({
         k: res[k] for k in
         ("seeds_run", "rounds", "rounds_done_total", "distinct_schedules",
          "saturated", "crashes", "corpus_size", "buckets_total",
-         "buckets_opened") if k in res}))
+         "buckets_opened", "shards") if k in res}))
     return 0
 
 

@@ -1,0 +1,45 @@
+"""The run-twice fixed-point guard, shared by every replay-authoritative
+path: `analyze.replay_race`, the campaign-resume verification in
+`search.fuzz`/`search.shard`, and `service.replay_bucket`.
+
+Rationale (ROADMAP r12 note): on this jaxlib, the FIRST invocation of a
+fused executable deserialized from the persistent compile cache can
+return a deterministic-but-wrong result under concurrent machine load;
+a re-invocation of the same executable is always correct. A value that
+something treats as replay-TRUTH must therefore not depend on that coin
+flip: re-run until two CONSECUTIVE invocations agree. Three pairwise
+distinct results are beyond the transient — that is real nondeterminism
+and must raise, never be papered over. One implementation here, so the
+agreement contract cannot drift between its call sites (the PR 7
+addendum collapsed the knob-reapply copies into
+`search.mutate.apply_repro_knobs` for the same reason).
+"""
+
+from __future__ import annotations
+
+
+def agree_twice(first, again, key_of=lambda r: r, what: str = "replay",
+                detail=None):
+    """Return a result confirmed by two consecutive agreeing
+    invocations.
+
+    `first` is the already-computed first result; `again(first)`
+    recomputes it (the callable may ignore its argument — it is handed
+    the first result so callers can re-dispatch the same operands
+    without re-closing over them). `key_of` projects a result onto the
+    values that must agree (comparison keys, not e.g. device handles).
+    On first==second, returns `first`; else a third invocation breaks
+    the tie (third==second returns `second`). Three distinct results
+    raise RuntimeError — `what` names the authority in the message and
+    `detail(first, second, third)`, when given, appends specifics."""
+    second = again(first)
+    if key_of(second) == key_of(first):
+        return first
+    third = again(first)
+    if key_of(third) != key_of(second):
+        extra = f": {detail(first, second, third)}" if detail else ""
+        raise RuntimeError(
+            f"{what} does not replay deterministically — three "
+            f"invocations disagree{extra}; this is beyond the known "
+            "first-invocation compile-cache transient (ROADMAP r12 note)")
+    return second

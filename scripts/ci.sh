@@ -53,6 +53,13 @@ case "$tier" in
     # uninterrupted run, and a structurally different runtime must be
     # rejected by the store's version/signature contract
     python bench.py --campaign-smoke
+    # mesh-sharded-campaign smoke: a 1-shard sharded campaign must write
+    # a byte-identical durable store to the unsharded fuzzer, a 2-shard
+    # CPU-mesh campaign must merge both shard namespaces (coverage
+    # superset, foreign entries delivered, consensus tally serialized),
+    # and a split 2-shard campaign must resume equal to the
+    # uninterrupted control with the verify_resume guard armed
+    python bench.py --shard-smoke
     # DetSan smoke: the repo-wide determinism lint gate must be clean,
     # a seeded schedule race must confirm via the forced-commute PCT
     # nudge with a replayable (seed, knobs, nudge) repro and dedupe
