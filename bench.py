@@ -438,7 +438,8 @@ def _sched_ab_mode():
     print(json.dumps(out))
 
 
-def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0):
+def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0,
+                        profile=False):
     """A deliberately tiny workload (2-node ping-pong, C=16, P=2, stats
     off) for the fused A/B: per-step device compute is small, so the
     per-chunk host round-trip the chunked runner pays
@@ -453,6 +454,7 @@ def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0):
     cfg = SimConfig(n_nodes=n_nodes, event_capacity=16, payload_words=2,
                     time_limit=sec(590), collect_stats=False,
                     trace_cap=trace_cap, sketch_slots=sketch_slots,
+                    profile=profile,
                     net=NetConfig(packet_loss_rate=loss,
                                   send_latency_min=ms(1),
                                   send_latency_max=ms(4)))
@@ -663,7 +665,8 @@ def _make_saturating_runtime(target=6, trace_cap=0, sketch_slots=0):
                    scenario=sc)
 
 
-def _make_crashrich_runtime(kind="wal_kv", trace_cap=0, sketch_slots=0):
+def _make_crashrich_runtime(kind="wal_kv", trace_cap=0, sketch_slots=0,
+                            profile=False):
     """Crash-RICH flagship targets for --mode search_ab / --causal-smoke
     (ROADMAP r9 open item): green Raft's randomized election timeouts
     saturate the schedule ceiling but rarely crash, so its
@@ -687,7 +690,7 @@ def _make_crashrich_runtime(kind="wal_kv", trace_cap=0, sketch_slots=0):
             sc.at(ms(210) + ms(250) * t).restart(0)
         cfg = SimConfig(n_nodes=3, event_capacity=256, payload_words=8,
                         time_limit=sec(10), trace_cap=trace_cap,
-                        sketch_slots=sketch_slots,
+                        sketch_slots=sketch_slots, profile=profile,
                         net=NetConfig(send_latency_min=ms(1),
                                       send_latency_max=ms(8)))
         return make_wal_kv_runtime(n_clients=2, n_ops=12, wal_cap=64,
@@ -700,7 +703,7 @@ def _make_crashrich_runtime(kind="wal_kv", trace_cap=0, sketch_slots=0):
         sc.at(ms(330) + ms(400) * t).restart_random(among=replicas)
     cfg = SimConfig(n_nodes=6, event_capacity=384, payload_words=12,
                     time_limit=sec(10), trace_cap=trace_cap,
-                    sketch_slots=sketch_slots,
+                    sketch_slots=sketch_slots, profile=profile,
                     net=NetConfig(send_latency_min=ms(1),
                                   send_latency_max=ms(8)))
     return make_chain_runtime(n_replicas=3, n_clients=2, n_ops=10,
@@ -1490,6 +1493,183 @@ def _obs_smoke_mode():
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
+def _prof_ab_mode():
+    """--mode prof_ab: sim-profiler counter-plane overhead A/B on the
+    fused runner, the r7 obs_ab protocol exactly (worst-case tiny step,
+    interleaved min-of-9 reps so machine drift hits every variant
+    equally). Three builds, identical trajectories by construction (the
+    counter writes consume no randomness):
+
+      off          profile=False — counters compiled out (baseline; the
+                   acceptance bar puts this within noise)
+      prof_masked  profile=True compiled in, NO lanes counted — the
+                   cost of carrying the counter columns + masked
+                   saturating writes; the ship-with-it shape, bar ≤3%
+                   at B=512
+      prof_on      profile=True, every lane counts — the ceiling
+
+    Writes BENCH_prof_ab_<platform>.json next to this file."""
+    _preflight_or_cpu("--prof-ab")
+    import jax
+    platform = jax.devices()[0].platform
+    B, steps, chunk, reps = 512, 2048, 256, 9
+    variants = (("off", False, None), ("prof_masked", True, []),
+                ("prof_on", True, None))
+    out = {"metric": "prof_ab", "platform": platform, "batch": B,
+           "steps": steps, "chunk": chunk, "reps": reps,
+           "note": ("tiny 2-node workload = worst case for relative "
+                    "counter overhead (fixed per-step writes vs tiny "
+                    "step); fused runner, lanes never halt, identical "
+                    "step counts per variant; reps interleaved "
+                    "round-robin, min-of-reps. prof_masked and prof_on "
+                    "execute identical compute (masked writes run "
+                    "either way) — spread between them is the noise "
+                    "floor. Bars: prof_masked <= 3%, off-vs-off "
+                    "baseline within noise by construction"),
+           "variants": {}}
+    seeds = np.arange(B)
+    by_prof = {p: _make_light_runtime(profile=p)
+               for p in {p for _, p, _ in variants}}
+    rts, kws = {}, {}
+    for name, prof, lanes in variants:
+        rts[name] = by_prof[prof]
+        kws[name] = ({} if not prof or lanes is None
+                     else {"profile_lanes": lanes})
+    for rt in by_prof.values():
+        jax.block_until_ready(
+            rt.run_fused(rt.init_batch(seeds), steps, chunk).now)
+    best = {name: float("inf") for name, _, _ in variants}
+    for _ in range(reps):
+        for name, _, _ in variants:
+            state = rts[name].init_batch(seeds, **kws[name])
+            jax.block_until_ready(state.now)
+            t0 = time.perf_counter()
+            final = rts[name].run_fused(state, steps, chunk)
+            jax.block_until_ready(final.now)
+            best[name] = min(best[name], time.perf_counter() - t0)
+    eps = {name: B * steps / b for name, b in best.items()}
+    for name, _, _ in variants:
+        out["variants"][name] = round(eps[name], 1)
+        print(f"--prof-ab: {name} {eps[name]:,.0f} seed-events/s",
+              file=sys.stderr)
+    for name in ("prof_masked", "prof_on"):
+        out[f"overhead_{name}"] = round(eps["off"] / eps[name] - 1, 4)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_prof_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _prof_smoke_mode():
+    """--prof-smoke: seconds-scale profiler self-test for CI (wired into
+    scripts/ci.sh fast):
+
+      1. on a seeded chaos run (crash-rich wal_kv, FIXED kill targets)
+         the on-device counters must match a host-replayed reference
+         computed from the collect_events stream — per-(node, kind)
+         dispatch counts and per-node busy time exactly, and the
+         kill/restart counters must see the scenario's injections;
+      2. profiling must be free of trajectory influence: fingerprints
+         equal across profile on/off, and fused == chunked on every
+         counter column;
+      3. the Perfetto counter tracks must export as valid JSON with
+         queue_depth/busy%/cov_divergence tracks alongside the instants;
+      4. a small fuzz campaign's rounds must report per-operator
+         coverage yield that sums to each round's admissions.
+
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import json as _json
+    import tempfile
+    from madsim_tpu.core.state import N_EV_KINDS, TRACE_FIELDS
+    from madsim_tpu.obs import export_profile_trace, profile_summary
+    t0 = time.perf_counter()
+    seeds = np.arange(24, dtype=np.uint32)
+
+    # 1+2: counters vs host replay, bit-identity, fused == chunked
+    rt = _make_crashrich_runtime("wal_kv", trace_cap=64, sketch_slots=8,
+                                 profile=True)
+    rt_off = _make_crashrich_runtime("wal_kv", trace_cap=64,
+                                     sketch_slots=8)
+    chunked, events = rt.run(rt.init_batch(seeds), 4096, 512,
+                             collect_events=True)
+    fused = rt.run_fused(rt.init_batch(seeds), 4096, 512)
+    off, _ = rt_off.run(rt_off.init_batch(seeds), 4096, 512)
+    assert (rt.fingerprints(chunked) == rt.fingerprints(fused)).all()
+    assert (rt.fingerprints(chunked) == rt_off.fingerprints(off)).all(), \
+        "profiling perturbed the trajectory"
+    for f in TRACE_FIELDS:
+        assert (np.asarray(getattr(chunked, f))
+                == np.asarray(getattr(fused, f))).all(), f
+    fired = np.asarray(events["fired"])
+    now_s = np.asarray(events["now"])
+    kind_s = np.asarray(events["kind"])
+    node_s = np.asarray(events["node"])
+    disp = np.asarray(chunked.pf_dispatch)
+    busy = np.asarray(chunked.pf_busy)
+    N = rt.cfg.n_nodes
+    for b in range(len(seeds)):
+        idx = np.nonzero(fired[:, b])[0]
+        ref_disp = np.zeros((N, N_EV_KINDS), np.int64)
+        ref_busy = np.zeros(N, np.int64)
+        prev = 0
+        for i in idx:
+            nd, kd, nw = int(node_s[i, b]), int(kind_s[i, b]), \
+                int(now_s[i, b])
+            ref_disp[nd, kd] += 1
+            ref_busy[nd] += nw - prev
+            prev = nw
+        assert (disp[b] == ref_disp).all(), (b, disp[b], ref_disp)
+        assert (busy[b] == ref_busy).all(), (b, busy[b], ref_busy)
+    kills = np.asarray(chunked.pf_kill)
+    assert (kills[:, 0] >= 1).all(), "scheduled kills of node 0 not seen"
+    assert int(np.asarray(chunked.pf_qmax).max()) > 0
+    summ = profile_summary(chunked)
+    assert summ["dispatches"] == int(disp.sum())
+
+    # 3: Perfetto counter tracks next to the instants
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "prof.json")
+        n_inst = export_profile_trace(p, fused, lane=0)
+        with open(p) as f:
+            doc = _json.load(f)
+        counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+        names = {e["name"] for e in counters}
+        assert "queue_depth" in names and "cov_divergence" in names
+        assert any(nm.startswith("busy_pct:") for nm in names), names
+        assert n_inst == len([e for e in doc["traceEvents"]
+                              if e.get("ph") == "i"]) > 0
+
+    # 4: operator-yield attribution sums to admissions, every round
+    import io
+    from madsim_tpu.obs import JsonlObserver
+    from madsim_tpu.search.fuzz import fuzz
+    srt = _make_saturating_runtime()
+    obs = JsonlObserver(io.StringIO())
+    res = fuzz(srt, max_steps=400, batch=32, max_rounds=4, dry_rounds=9,
+               chunk=128, rng_seed=0, observer=obs)
+    rounds = [r for r in obs.records if r.get("kind") == "fuzz_round"]
+    assert rounds, "no fuzz rounds observed"
+    for rec in rounds:
+        assert sum(rec["op_yield"].values()) == rec["admitted"], rec
+    assert sum(res["mutation_yield"].values()) \
+        == sum(r["admitted"] for r in rounds)
+    mutated_yield = sum(v for k, v in res["mutation_yield"].items()
+                        if k != "base")
+    assert res["corpus_energy"]["entries"] == res["corpus_size"]
+    print(_json.dumps({
+        "metric": "prof_smoke", "platform": "cpu", "ok": True,
+        "lanes_checked": int(len(seeds)),
+        "dispatches": int(disp.sum()),
+        "kills_seen": int(kills[:, 0].sum()),
+        "counter_tracks": sorted(names),
+        "admitted_total": int(sum(r["admitted"] for r in rounds)),
+        "mutant_yield": int(mutated_yield),
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
 def _causal_ab_mode():
     """--mode causal_ab: causal-lineage + prefix-sketch overhead A/B on
     the fused runner, same protocol as obs_ab (interleaved min-of-reps
@@ -2098,13 +2278,19 @@ def main():
                  "--compile-smoke", "--search-ab", "--search-smoke",
                  "--causal-ab", "--causal-smoke", "--campaign",
                  "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
-                 "--shard", "--shard-smoke"}
+                 "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
         sys.argv.append(flag)
     if "--analyze-smoke" in sys.argv:
         _analyze_smoke_mode()
+        return
+    if "--prof-ab" in sys.argv:
+        _prof_ab_mode()
+        return
+    if "--prof-smoke" in sys.argv:
+        _prof_smoke_mode()
         return
     if "--detsan-ab" in sys.argv:
         _detsan_ab_mode()

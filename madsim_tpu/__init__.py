@@ -34,6 +34,9 @@ from .obs import (
     SweepObserver,
     explain_crash,
     export_chrome_trace,
+    export_profile_trace,
+    format_profile,
+    profile_summary,
     ring_records,
 )
 from .harness.minimize import minimize_scenario
@@ -62,6 +65,7 @@ __all__ = [
     "with_prio_nudge",
     "SweepObserver", "JsonlObserver", "ProgressObserver", "ring_records",
     "export_chrome_trace", "explain_crash", "divergence_profile",
+    "profile_summary", "format_profile", "export_profile_trace",
     "CorpusStore", "run_campaign", "supervise_campaign", "campaign_report",
     "merged_buckets", "replay_bucket",
     "lint_runtime", "find_races", "confirm_race", "scan_races",

@@ -27,21 +27,32 @@ from flax import struct
 from . import types as T
 
 # SimState fields owned by the flight recorder (cfg.trace_cap), the
-# causal-lineage layer (r10 — rides the same gate), and the
-# prefix-coverage sketch (cfg.sketch_slots). One schema constant so
-# every consumer follows it automatically: excluded from fingerprints
-# (utils/hashing — observation only, never a replay domain), read by
-# obs/rings.py (the tr_* columns), compared explicitly in the
-# fused-vs-chunked equivalence tests and bench.py --obs-smoke /
-# --causal-smoke. trace_cap is the DYNAMIC capacity operand (columns
-# are sized to the power-of-two bucket, cfg.trace_cap_bucket — DESIGN
-# §10); sketch_every is the DYNAMIC fold period for the structurally
-# sized cov_sketch column (DESIGN §12).
+# causal-lineage layer (r10 — rides the same gate), the
+# prefix-coverage sketch (cfg.sketch_slots), and the sim-profiler
+# counter plane (cfg.profile, r15 — the pf_* columns + the tr_qlen
+# ring column). One schema constant so every consumer follows it
+# automatically: excluded from fingerprints (utils/hashing —
+# observation only, never a replay domain), read by obs/rings.py (the
+# tr_* columns) and obs/profiler.py (the pf_* columns), compared
+# explicitly in the fused-vs-chunked equivalence tests and bench.py
+# --obs-smoke / --causal-smoke / --prof-smoke. trace_cap is the
+# DYNAMIC capacity operand (columns are sized to the power-of-two
+# bucket, cfg.trace_cap_bucket — DESIGN §10); sketch_every is the
+# DYNAMIC fold period for the structurally sized cov_sketch column
+# (DESIGN §12).
 TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
                 "tr_kind", "tr_node", "tr_src", "tr_tag",
-                "tr_parent", "tr_lamport",
+                "tr_parent", "tr_lamport", "tr_qlen",
                 "ev_prov", "lamport",
-                "cov_sketch", "sketch_every")
+                "cov_sketch", "sketch_every",
+                "pf_on", "pf_dispatch", "pf_busy", "pf_kill", "pf_restart",
+                "pf_qmax", "pf_drop", "pf_delay")
+
+# pf_dispatch's kind axis: one column per event kind (EV_FREE's column
+# exists so t_kind values index directly but is never written — only
+# valid dispatches count, and a valid dispatch is never EV_FREE).
+# Derived from the enum so a new kind widens the counter automatically
+N_EV_KINDS = T.EV_SUPER + 1
 
 
 @struct.dataclass
@@ -169,6 +180,14 @@ class SimState:
                             # oldest surviving record
     tr_lamport: jax.Array   # int32[bucket] — the acting node's Lamport
                             # clock AFTER this dispatch
+    tr_qlen: jax.Array      # int32[bucket] — event-table occupancy at
+                            # this dispatch (rows pending INCLUDING the
+                            # row being dispatched) — the queue-depth
+                            # counter-track source (obs/profiler.py).
+                            # Compiled in only when BOTH the ring and
+                            # the profiler are (cfg.trace_cap > 0 and
+                            # cfg.profile); zero-size otherwise, and
+                            # ring readers skip zero-size columns
 
     # --- prefix-coverage sketch (cfg.sketch_slots; obs/causal.py) ---------
     # Slot j holds the running sched_hash (lanes XOR-folded) after this
@@ -179,6 +198,40 @@ class SimState:
     # round-trips during the run. 0 means "checkpoint not reached".
     cov_sketch: jax.Array   # uint32[sketch_slots]
     sketch_every: jax.Array  # int32 — DYNAMIC fold period (cfg.sketch_every)
+
+    # --- sim-profiler counter plane (cfg.profile; obs/profiler.py) --------
+    # Per-lane, on-device counters written through the step's existing
+    # one-hot dispatch machinery — where the simulated cluster spends
+    # its effort, resident in SimState so a fused while_loop sweep
+    # comes back with per-node utilization at zero new host
+    # round-trips. Observation only (TRACE_FIELDS): no randomness
+    # consumed, excluded from fingerprints, zero-size [N]/[N, K]
+    # columns when compiled out (cfg.profile=False). All counters
+    # SATURATE at int32 max — a long campaign reads "pegged", never a
+    # wrapped negative (DESIGN §16).
+    pf_on: jax.Array        # bool — lane gate (init_batch(profile_lanes=))
+    pf_dispatch: jax.Array  # int32[N, N_EV_KINDS] — dispatches by
+                            # (acting node, event kind); supervisor ops
+                            # count at the node _apply_super RESOLVED
+                            # (the Lamport-rule node), not the
+                            # NODE_RANDOM placeholder
+    pf_busy: jax.Array      # int32[N] — busy virtual time: each
+                            # dispatch's now-delta attributed to its
+                            # acting node (sums to final `now` over
+                            # nodes when every step advanced the clock)
+    pf_kill: jax.Array      # int32[N] — effective KILL/RESTART ops at
+                            # this node (crash injections landed)
+    pf_restart: jax.Array   # int32[N] — effective INIT/RESTART boots
+    pf_qmax: jax.Array      # int32 — event-table occupancy high-water
+                            # mark as seen at dispatch + emission time
+                            # (capacity tuning; unlike ev_peak this
+                            # also counts the pre-pop dispatch row and
+                            # rides the profile gate, not collect_stats)
+    pf_drop: jax.Array      # int32 — messages lost: send-side
+                            # clog/loss + deliveries to dead nodes
+    pf_delay: jax.Array     # int32 — total latency ticks added to
+                            # delivered sends (mean delay =
+                            # pf_delay / delivered sends)
 
     # --- extension state (plugin framework analog, plugin.rs) -------------
     ext: Any                # dict: extension name -> its state subtree
@@ -246,8 +299,23 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         tr_tag=jnp.zeros((cfg.trace_cap_bucket,), i32),
         tr_parent=jnp.zeros((cfg.trace_cap_bucket,), i32),
         tr_lamport=jnp.zeros((cfg.trace_cap_bucket,), i32),
+        # the queue-depth ring column needs both gates (see field docs)
+        tr_qlen=jnp.zeros((cfg.trace_cap_bucket if cfg.profile else 0,),
+                          i32),
         cov_sketch=jnp.zeros((cfg.sketch_slots,), jnp.uint32),
         sketch_every=jnp.asarray(cfg.sketch_every, i32),
+        # profiler default: every lane counts (when the plane is
+        # compiled in at all); init_batch(profile_lanes=...) narrows.
+        # Vector columns are zero-size when compiled out, scalars stay
+        # (never written then — same shape discipline as trace_pos)
+        pf_on=jnp.asarray(cfg.profile),
+        pf_dispatch=jnp.zeros((N if cfg.profile else 0, N_EV_KINDS), i32),
+        pf_busy=jnp.zeros((N if cfg.profile else 0,), i32),
+        pf_kill=jnp.zeros((N if cfg.profile else 0,), i32),
+        pf_restart=jnp.zeros((N if cfg.profile else 0,), i32),
+        pf_qmax=jnp.asarray(0, i32),
+        pf_drop=jnp.asarray(0, i32),
+        pf_delay=jnp.asarray(0, i32),
         ext=ext_state if ext_state is not None else {},
     )
 

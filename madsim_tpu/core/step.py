@@ -32,11 +32,22 @@ from ..ops import select as sel
 from . import prng
 from . import types as T
 from .api import Ctx, Program
-from .state import SimState
+from .state import N_EV_KINDS, SimState
 
 
 def _where_tree(mask, new, old):
     return jax.tree.map(lambda a, b: jnp.where(mask, a, b), new, old)
+
+
+_I32_MAX = np.int32(2**31 - 1)
+
+
+def _sat_add(a, d):
+    """a + d for nonnegative int32 `d`, SATURATING at int32 max instead
+    of wrapping — the profiler counter discipline (DESIGN §16): a pegged
+    counter reads as pegged, never as a wrapped negative. The wrapped
+    sum on the saturating branch is computed but never selected."""
+    return jnp.where(a > _I32_MAX - d, _I32_MAX, a + d)
 
 
 # node-state slice/scatter via one-hot over the [N] axis: a traced node
@@ -154,6 +165,14 @@ def make_step(
         idx = jnp.where(s.prio_nudge != 0, nudged, idx)
         valid = picked & any_ev & live
 
+        # ---- sim-profiler inputs (cfg.profile; obs/profiler.py) ----------
+        # Captured here, written in one block after the emission phase:
+        # queue depth at dispatch (pre-pop, so the dispatched row counts)
+        # and the clock advance this dispatch buys. Pure reductions over
+        # already-computed values — no randomness, no non-pf state.
+        if cfg.profile:
+            occ_disp = occupied.sum(dtype=jnp.int32)
+
         ev_kind = jnp.where(valid, sel.take1(s.t_kind, idx), T.EV_FREE)
         ev_node_raw = sel.take1(s.t_node, idx)  # may be NODE_RANDOM (super)
         ev_node = jnp.clip(ev_node_raw, 0, cfg.n_nodes - 1)
@@ -201,6 +220,8 @@ def make_step(
         # pop the slot; clock never runs backward (resumed nodes' past-due
         # events fire "now", the park/unpark analog of task.rs:134-137)
         now = jnp.where(valid, jnp.maximum(s.now, dmin), s.now)
+        if cfg.profile:
+            now_delta = now - s.now          # >= 0; 0 when not valid
         # strict >: the scenario's HALT op sits at exactly time_limit, and
         # same-deadline ties may dispatch before it without being late
         time_over = now > s.tlimit
@@ -319,6 +340,7 @@ def make_step(
         sent = delivered_drop = jnp.asarray(0, jnp.int32)
         overflow = jnp.asarray(False)
         high_water = jnp.asarray(0, jnp.int32)
+        delay_acc = jnp.asarray(0, jnp.int32)   # cfg.profile: latency sum
         if E > 0:
             free = s.t_kind == T.EV_FREE
             occupied_now = (~free).sum(dtype=jnp.int32)
@@ -361,6 +383,11 @@ def make_step(
                 sent = sent + e["m"].astype(jnp.int32)
                 delivered_drop = delivered_drop + (e["m"] & ~ok).astype(
                     jnp.int32)
+                if cfg.profile:
+                    # latency actually imposed on delivered sends (the
+                    # profiler's delay counter; dropped sends impose no
+                    # delay — they impose a drop)
+                    delay_acc = delay_acc + jnp.where(ok, latency, 0)
                 write = ok & slot_ok[j]
                 overflow = overflow | (ok & ~slot_ok[j])
                 em_write.append(write)
@@ -467,6 +494,49 @@ def make_step(
             steps=s.steps + valid.astype(jnp.int32),
         )
 
+        # ---- sim-profiler counter plane (cfg.profile; DESIGN §16) --------
+        # One block of saturating one-hot increments over values the step
+        # already computed: per-(node, kind) dispatch counts and per-node
+        # busy time at the ACTING node (for supervisor ops the node
+        # _apply_super resolved — the Lamport-rule node), effective
+        # kill/boot counts at the reset target, occupancy high-water,
+        # drop and delay totals. No randomness consumed, no non-pf state
+        # touched: trajectories are bit-identical across the knob, and
+        # the pf_* columns ride TRACE_FIELDS out of fingerprints.
+        if cfg.profile:
+            rec_p = valid & s.pf_on
+            act_node = jnp.where(is_super, reset_target, ev_node)
+            ohP = sel.row_onehot(cfg.n_nodes, act_node)      # [N]
+            k_oh = (jnp.arange(N_EV_KINDS, dtype=jnp.int32)
+                    == ev_kind)                              # [K]
+            was_kill = reset_mask & ((op == T.OP_KILL)
+                                     | (op == T.OP_RESTART))
+            was_boot = reset_mask & ((op == T.OP_INIT)
+                                     | (op == T.OP_RESTART))
+            s = s.replace(
+                pf_dispatch=_sat_add(
+                    s.pf_dispatch,
+                    (ohP[:, None] & k_oh[None, :] & rec_p)
+                    .astype(jnp.int32)),
+                pf_busy=_sat_add(s.pf_busy,
+                                 jnp.where(ohP & rec_p, now_delta, 0)),
+                pf_kill=_sat_add(s.pf_kill,
+                                 (ohP & was_kill & rec_p)
+                                 .astype(jnp.int32)),
+                pf_restart=_sat_add(s.pf_restart,
+                                    (ohP & was_boot & rec_p)
+                                    .astype(jnp.int32)),
+                pf_qmax=jnp.where(
+                    rec_p,
+                    jnp.maximum(s.pf_qmax,
+                                jnp.maximum(occ_disp, high_water)),
+                    s.pf_qmax),
+                pf_drop=_sat_add(s.pf_drop, jnp.where(
+                    rec_p, delivered_drop + dropped.astype(jnp.int32), 0)),
+                pf_delay=_sat_add(s.pf_delay,
+                                  jnp.where(rec_p, delay_acc, 0)),
+            )
+
         # ---- prefix-coverage sketch (cfg.sketch_slots; DESIGN §12) -------
         # Fold the running sched_hash into slot j = steps/every - 1 at
         # every sketch_every-th dispatch: slot j then witnesses the whole
@@ -549,7 +619,12 @@ def make_step(
             def ringput(col, v):
                 return jnp.where(oh, v.astype(col.dtype), col)
 
+            # queue-depth ring column: only when the profiler is also
+            # compiled in (its counter-track source; zero-size otherwise)
+            extra_cols = (dict(tr_qlen=ringput(s.tr_qlen, occ_disp))
+                          if cfg.profile else {})
             s = s.replace(
+                **extra_cols,
                 tr_now=ringput(s.tr_now, record["now"]),
                 tr_step=ringput(s.tr_step, s.steps - 1),
                 tr_kind=ringput(s.tr_kind, record["kind"]),

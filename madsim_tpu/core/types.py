@@ -257,6 +257,22 @@ class SimConfig:
     # DYNAMIC (SimState.sketch_every — retune without recompile).
     sketch_slots: int = 0
     sketch_every: int = 64
+    # sim-profiler counter plane (obs/profiler.py, DESIGN §16): False
+    # (default) compiles the counters out entirely — zero-size columns,
+    # no counter code in the step. True adds per-lane, on-device
+    # counters written through the step's existing one-hot dispatch
+    # machinery: per-node dispatch counts by event kind, per-node busy
+    # virtual time, event-table occupancy high-water mark, message
+    # drop/delay totals, per-node kill/restart counts. Counters SATURATE
+    # at int32 max instead of wrapping. Like trace_cap, an observation
+    # lever, not a replay domain: the writes consume no randomness and
+    # touch no non-counter state, so trajectories are BIT-IDENTICAL
+    # across settings and the pf_* columns are excluded from
+    # fingerprints (TRACE_FIELDS). Per-lane masking rides
+    # `init_batch(profile_lanes=...)` — a build can ship with
+    # profile=True and flip lanes on per sweep (the masked-off overhead
+    # bar is ≤3% on the tiny-step worst case, bench.py --mode prof_ab).
+    profile: bool = False
     # emission-write lowering: how staged emissions land in the event
     # table. "onehot" = [E, C] one-hot masked-sum (VPU-friendly — the TPU
     # default); "scatter" = one XLA scatter per column at distinct slot
@@ -274,6 +290,7 @@ class SimConfig:
         assert self.payload_words >= 1
         assert self.trace_cap >= 0
         assert self.sketch_slots >= 0
+        assert isinstance(self.profile, bool)
         assert self.sketch_every >= 1
         assert self.table_dtype in ("int32", "int16")
         assert self.emission_write in ("auto", "onehot", "scatter")
@@ -298,10 +315,11 @@ class SimConfig:
         ride as operands. `emission_write` stays raw here — 'auto'
         resolves per backend at trace time, and the cache keys the
         backend separately."""
-        return ("simconfig-v2", self.n_nodes, self.event_capacity,
+        return ("simconfig-v3", self.n_nodes, self.event_capacity,
                 self.payload_words, self.table_dtype, self.emission_write,
                 bool(self.collect_stats), self.trace_cap_bucket,
-                self.sketch_slots, self.net.op_jitter_max > 0)
+                self.sketch_slots, self.net.op_jitter_max > 0,
+                bool(self.profile))
 
     def hash(self) -> str:
         """Stable 8-hex-digit config hash, printed on test failure so a repro

@@ -24,11 +24,20 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   from a crash to its minimal causal chain, and
                   `sketch_divergence` reads where two lanes' schedules
                   first split from the on-device prefix sketches.
+  * profiler.py — (r15) the WHERE layer: reports + Perfetto counter
+                  tracks over the `cfg.profile` counter plane
+                  (SimState pf_* columns — per-node dispatch/busy,
+                  queue pressure, drop/delay, kill/boot counts), fed by
+                  the on-device `parallel.stats.profile_digest`
+                  reduction. O(counters) per sweep crosses the host
+                  boundary, at syncs the runners already pay.
 """
 
 from .causal import (causal_fingerprint, code_fingerprint, explain_crash,
                      fingerprints_match, happens_before, sketch_divergence)
 from .metrics import JsonlObserver, SweepObserver, TeeObserver
+from .profiler import (counter_track_events, export_profile_trace,
+                       format_profile, profile_summary)
 from .progress import ProgressObserver
 from .rings import ring_records, sampled_lanes
 from .trace import export_chrome_trace, to_chrome_events
@@ -39,4 +48,6 @@ __all__ = [
     "export_chrome_trace",
     "explain_crash", "happens_before", "sketch_divergence",
     "causal_fingerprint", "code_fingerprint", "fingerprints_match",
+    "profile_summary", "format_profile", "counter_track_events",
+    "export_profile_trace",
 ]

@@ -13,7 +13,13 @@ blocked on anyway. Record kinds (each a flat JSON-able dict carrying
   round    one explore() round harvested: new_schedules, distinct_total,
            crashes — the per-round coverage growth off the existing
            on-device digest. fuzz() rounds arrive as kind="fuzz_round"
-           with corpus_size/new_crash_codes, plus div_slot_p50 (the
+           with corpus_size/new_crash_codes, plus (r15) `admitted`,
+           `op_yield` — the round's admissions attributed to the havoc
+           operator that produced each admitted mutant ("base" =
+           untouched lanes; the per-operator counts sum to `admitted`)
+           — and `corpus_energy` (the scheduler's energy distribution:
+           entries/total/mean/p50/p90/max/crash_entries), plus
+           div_slot_p50 (the
            round's median first-divergence slot vs the consensus prefix)
            when the build compiles the prefix sketch in
            (cfg.sketch_slots > 0) — depth telemetry riding the sketch
@@ -47,6 +53,7 @@ silently eats its own bugs measures nothing).
 from __future__ import annotations
 
 import json
+import os
 from typing import IO
 
 
@@ -75,11 +82,22 @@ class JsonlObserver(SweepObserver):
     `sink` is a path (opened for append; close() or use as a context
     manager) or an open file-like object (caller owns its lifetime).
     Floats are rounded — these are metrics, not measurements to diff.
+
+    Every record is flushed as written, so a SIGKILL'd process's log is
+    complete up to its last record; `fsync=True` additionally fsyncs per
+    record, extending that claim to power loss — campaign workers use
+    it (service/worker.py): under `supervise_campaign` respawns the
+    worker log is durable telemetry, and the r15 timeline trusts it.
+    fsync needs a real file descriptor; sinks without `fileno()`
+    (StringIO) raise at construction rather than silently not syncing.
     """
 
-    def __init__(self, sink: str | IO[str]):
+    def __init__(self, sink: str | IO[str], fsync: bool = False):
         self._own = isinstance(sink, str)
         self._f = open(sink, "a") if self._own else sink
+        self._fsync = fsync
+        if fsync:
+            self._f.fileno()    # fail here, not at first record
         self.records: list[dict] = []
 
     def _emit(self, rec: dict) -> None:
@@ -88,6 +106,8 @@ class JsonlObserver(SweepObserver):
         self.records.append(rec)
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
+        if self._fsync:
+            os.fsync(self._f.fileno())
 
     on_chunk = on_compact = on_round = on_compile = on_done = _emit
 

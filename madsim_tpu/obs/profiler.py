@@ -1,0 +1,198 @@
+"""Sim-profiler reports + Perfetto counter tracks over the counter plane.
+
+The WHERE layer of the observability stack (DESIGN §16): the r7 ring
+answers *what happened*, the r10 lineage answers *why* — this module
+answers *where the simulated cluster spends its effort*, from the
+`cfg.profile` counter columns that live IN SimState (core/state.py
+`pf_*`) and therefore survive the fused while_loop with zero new host
+round-trips. Two consumers:
+
+  * `profile_summary` / `format_profile` — the report object: batch-sum
+    counters off the on-device `parallel.stats.profile_digest` reduction
+    (O(counters) host transfer) plus derived rates — per-node busy%,
+    dispatch mix by event kind, drop rate, mean imposed delay, queue
+    high-water percentiles. `summarize()` carries an abbreviated form in
+    its `profile` key.
+  * Perfetto COUNTER tracks next to the r7 instants and r10 flow arrows:
+    `counter_track_events` renders queue depth over virtual time (the
+    `tr_qlen` ring column — compiled in when profile AND trace are),
+    cumulative per-node busy% (derived from the ring's now-deltas —
+    each dispatch's clock advance belongs to its acting node), and the
+    lane's divergence-from-consensus step off the r10 `cov_sketch`.
+    `export_profile_trace` writes one document with instants + flows +
+    counters, so a crash timeline and the pressure curves line up on
+    one virtual-time axis in ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..parallel.stats import profile_counters
+from .rings import ring_records
+from .trace import _doc, to_chrome_events
+
+# pf_dispatch's kind axis, named (core/types.py event kinds; FREE never
+# counts — a valid dispatch is never EV_FREE)
+KIND_NAMES = ("free", "msg", "timer", "super")
+
+
+def profile_summary(state) -> dict | None:
+    """The profiler report for a (finished or running) batched state:
+    raw batch-sum counters plus derived rates. None when the counter
+    plane is compiled out (cfg.profile=False) or the state is unbatched.
+
+    Derived fields:
+      busy_pct[n]    node n's busy virtual time as % of the profiled
+                     lanes' total virtual time (sums to ~100 when every
+                     dispatch advanced the clock — idle gaps and
+                     zero-delta dispatches make it undershoot, never
+                     overshoot)
+      dispatch_mix   total dispatches by event kind name
+      drop_rate      drops per dispatched event
+      mean_delay_us  pf_delay / delivered message dispatches
+    """
+    c = profile_counters(state)
+    if c is None:
+        return None
+    disp = np.asarray(c["dispatch"], np.int64)          # [N, K]
+    busy = np.asarray(c["busy"], np.int64)              # [N]
+    total_disp = int(disp.sum())
+    total_now = max(c["now_sum"], 1)
+    msgs = int(disp[:, 1].sum())
+    out = dict(
+        lanes=c["lanes"],
+        dispatches=total_disp,
+        dispatch_by_node=disp.sum(-1).tolist(),
+        dispatch_mix={KIND_NAMES[k]: int(disp[:, k].sum())
+                      for k in range(disp.shape[1]) if disp[:, k].sum()},
+        busy_us=busy.tolist(),
+        busy_pct=[round(100.0 * b / total_now, 2) for b in busy.tolist()],
+        kills=c["kill"].tolist(),
+        restarts=c["restart"].tolist(),
+        drops=c["drop"],
+        drop_rate=round(c["drop"] / max(total_disp, 1), 4),
+        delay_ticks=c["delay"],
+        mean_delay_us=round(c["delay"] / max(msgs, 1), 1),
+        queue_p50=c["qmax_p50"], queue_p90=c["qmax_p90"],
+        queue_max=c["qmax_max"],
+        steps_p50=c["steps_p50"], steps_p90=c["steps_p90"],
+        steps_max=c["steps_max"],
+    )
+    return out
+
+
+def format_profile(summary: dict, node_names=None) -> str:
+    """Render a `profile_summary` dict as a fixed-width text table —
+    the operator-facing report (`python -m`-free: print it)."""
+    if summary is None:
+        return "profiler compiled out (SimConfig.profile=False)"
+    N = len(summary["dispatch_by_node"])
+    name = (node_names if node_names is not None
+            else [f"node{n}" for n in range(N)])
+    lines = [
+        f"profiled lanes: {summary['lanes']}  "
+        f"dispatches: {summary['dispatches']}  "
+        f"mix: {summary['dispatch_mix']}",
+        f"drops: {summary['drops']} ({summary['drop_rate']:.2%}/event)  "
+        f"mean delay: {summary['mean_delay_us']}us  "
+        f"queue p50/p90/max: {summary['queue_p50']}/"
+        f"{summary['queue_p90']}/{summary['queue_max']}",
+        f"{'node':<12} {'dispatches':>10} {'busy_us':>12} {'busy%':>7} "
+        f"{'kills':>6} {'boots':>6}",
+    ]
+    for n in range(N):
+        lines.append(
+            f"{name[n]:<12} {summary['dispatch_by_node'][n]:>10} "
+            f"{summary['busy_us'][n]:>12} {summary['busy_pct'][n]:>7} "
+            f"{summary['kills'][n]:>6} {summary['restarts'][n]:>6}")
+    return "\n".join(lines)
+
+
+def _counter(name: str, ts: int, value, series: str = "value",
+             pid: int = 0) -> dict:
+    return dict(name=name, ph="C", ts=int(ts), pid=pid,
+                args={series: float(value)})
+
+
+def counter_track_events(state, lane: int = 0, node_names=None,
+                         consensus=None, recs=None) -> list[dict]:
+    """Perfetto counter-track events for one lane, from the ring window
+    (cfg.trace_cap > 0; the lane must be sampled):
+
+      queue_depth    event-table occupancy at each dispatch (`tr_qlen` —
+                     present only on cfg.profile builds; omitted, not
+                     zeroed, elsewhere)
+      busy_pct:<n>   node n's cumulative busy share of the ring window's
+                     virtual time, from the ring's now-deltas (the delta
+                     of each dispatch belongs to its record's node) —
+                     window-relative after a ring wrap
+      cov_divergence 0/1 step track: whether this lane's prefix sketch
+                     had left the batch-consensus prefix by the
+                     checkpoint nearest each ring record (cfg.sketch_slots
+                     builds only; `consensus` overrides the batch modal,
+                     e.g. with a campaign's cross-round consensus)
+
+    Timestamps ride the same virtual-time axis as the r7 instants, so
+    the tracks align with the event timeline in one document. Pass an
+    already-unwrapped `recs` (a `ring_records` dict for this lane) to
+    skip re-reading the ring — `export_profile_trace` does, halving
+    its host transfer.
+    """
+    if recs is None:
+        recs = ring_records(state, lane)
+    n = len(recs["now"])
+    out = []
+    qlen = recs.get("qlen")
+    if qlen is not None:
+        out += [_counter("queue_depth", recs["now"][i], qlen[i], "depth")
+                for i in range(n)]
+    # cumulative busy% per node over the ring window
+    if n:
+        t0 = int(recs["now"][0])
+        nodes = sorted({int(x) for x in recs["node"]})
+        label = {nd: (node_names[nd] if node_names is not None
+                      else f"node{nd}") for nd in nodes}
+        busy = {nd: 0 for nd in nodes}
+        prev = t0
+        for i in range(n):
+            now_i = int(recs["now"][i])
+            busy[int(recs["node"][i])] += now_i - prev
+            prev = now_i
+            span = max(now_i - t0, 1)
+            for nd in nodes:
+                out.append(_counter(f"busy_pct:{label[nd]}", now_i,
+                                    round(100.0 * busy[nd] / span, 2),
+                                    "busy_pct"))
+    sk = np.asarray(getattr(state, "cov_sketch", np.zeros((0, 0))))
+    if n and sk.ndim == 2 and sk.shape[1] > 0:
+        from ..parallel.stats import first_divergence_slots
+        every = int(np.atleast_1d(
+            np.asarray(state.sketch_every)).reshape(-1)[0])
+        div_slot = int(first_divergence_slots(
+            sk, consensus=consensus)[lane])
+        # 0/1 step track sampled at the ring records: diverged once the
+        # record's dispatch index passes the first divergent checkpoint
+        for i in range(n):
+            diverged = int(recs["step"][i]) >= (div_slot + 1) * every - 1
+            out.append(_counter("cov_divergence", recs["now"][i],
+                                1.0 if diverged else 0.0, "diverged"))
+    return out
+
+
+def export_profile_trace(path: str, state, lane: int = 0,
+                         node_names=None, consensus=None) -> int:
+    """Write one Perfetto/Chrome JSON document for `lane`: the r7
+    instant events and r10 flow arrows (`to_chrome_events` over the
+    ring) PLUS the profiler counter tracks, all on one virtual-time
+    axis. Returns the instant-event count (the `export_chrome_trace`
+    contract — counters annotate dispatches, they aren't dispatches)."""
+    recs = ring_records(state, lane)     # one unwrap serves both halves
+    events = to_chrome_events(recs)
+    events += counter_track_events(state, lane, node_names=node_names,
+                                   consensus=consensus, recs=recs)
+    with open(path, "w") as f:
+        json.dump(_doc(events, node_names), f)
+    return sum(1 for e in events if e["ph"] == "i")

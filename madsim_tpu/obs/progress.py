@@ -20,6 +20,16 @@ def _rate(x: float) -> str:
     return f"{x:.0f}"
 
 
+def _top_yield(op_yield) -> str:
+    """Render the most productive mutation operator of a round/shard
+    ("  yield time_nudge:3") — empty when nothing was admitted or the
+    record predates yield attribution (r15)."""
+    if not op_yield:
+        return ""
+    name, n = max(op_yield.items(), key=lambda kv: kv[1])
+    return f"  yield {name}:{n}" if n else ""
+
+
 class ProgressObserver:
     def __init__(self, stream=None, min_interval: float = 0.5):
         self.stream = stream if stream is not None else sys.stderr
@@ -90,7 +100,8 @@ class ProgressObserver:
         self._show(
             f"round {rec['round']:>3}  +{rec['new_schedules']} new "
             f"schedules ({rec['distinct_total']} distinct)  "
-            f"crashes {rec['crashes']}{corpus}{shards}", force=True)
+            f"crashes {rec['crashes']}{corpus}{shards}"
+            f"{_top_yield(rec.get('op_yield'))}", force=True)
         if rec.get("shards", 1) > 1 and rec.get("per_shard"):
             # one row per shard — a mesh campaign's telemetry must not
             # collapse the mesh into one line (wall_s is the round's
@@ -104,7 +115,8 @@ class ProgressObserver:
                     f"corpus {row['corpus_size']:>4}  "
                     f"coverage {row['coverage']:>5}  "
                     f"+{row['new']} new  crashes {row['crashes']}  "
-                    f"{_rate(row['seeds_run'] / wall)} sched/s\n")
+                    f"{_rate(row['seeds_run'] / wall)} sched/s"
+                    f"{_top_yield(row.get('op_yield'))}\n")
             self.stream.flush()
             self._line_open = False
 

@@ -65,8 +65,16 @@ def ring_records(state, lane: int = 0) -> dict:
     # Columns a state lacks (pre-r10 checkpoints, synthetic fixtures
     # without the lineage pair) are simply absent from the record dict —
     # consumers .get() them (obs/trace.py, obs/causal.py).
+    # .shape alone — a np.asarray here would device-to-host copy every
+    # column a second time just to learn its length
+    if state.tr_now.shape[-1] == 0:
+        raise ValueError("trace ring is compiled out (cfg.trace_cap == 0)")
+    # zero-size columns are COMPILED-OUT columns riding a narrower gate
+    # than the ring itself (tr_qlen needs cfg.profile too) — skip them
+    # like absent ones, same .get() contract for consumers
     cols = {k: owned_host_copy(getattr(state, f"tr_{k}")) for k in _COLS
-            if hasattr(state, f"tr_{k}")}
+            if hasattr(state, f"tr_{k}")
+            and getattr(state, f"tr_{k}").shape[-1] > 0}
     pos = np.asarray(state.trace_pos)
     on = np.asarray(state.trace_on)
     # LOGICAL capacity is the dynamic state operand (cfg.trace_cap);

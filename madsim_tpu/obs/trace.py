@@ -45,7 +45,9 @@ def _event(now, kind, node, src, tag, **extra):
 
 
 def _doc(events: list[dict], node_names=None) -> dict:
-    tids = sorted({e["tid"] for e in events})
+    # counter-track events (ph="C", obs/profiler.py) carry no tid —
+    # thread metadata names only the per-node instant/flow tracks
+    tids = sorted({e["tid"] for e in events if "tid" in e})
     meta = [dict(name="thread_name", ph="M", pid=0, tid=t,
                  args=dict(name=(node_names[t] if node_names is not None
                                  else f"node{t}")))

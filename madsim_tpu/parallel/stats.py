@@ -164,6 +164,118 @@ def divergence_profile(state) -> dict | None:
     return out
 
 
+@jax.jit
+def _profile_digest(pf_dispatch, pf_busy, pf_kill, pf_restart, pf_qmax,
+                    pf_drop, pf_delay, pf_on, steps, now):
+    """Device-side reduction of the sim-profiler counter plane
+    (cfg.profile, DESIGN §16): batch sums over the PROFILED lanes plus
+    per-lane percentiles, so only the O(counters) summary crosses the
+    host boundary — the same ship-summaries discipline as
+    `coverage_digest`. Percentiles are computed by sorting with masked
+    lanes pushed to +inf and indexing at the profiled-lane count, so a
+    partially-masked batch never dilutes its own statistics.
+
+    Batch sums are WIDE: int64 is unavailable without x64, and a plain
+    int32 sum of per-lane counters wraps at realistic scale (512 lanes
+    × ~1e7 busy ticks > 2^31) — exactly the wrapped-negative reading
+    the saturating per-lane counters exist to prevent. Each counter is
+    split into 16-bit halves and the halves summed separately (`_s64`);
+    the host recombines hi·2^16 + lo into exact Python ints. Half-sums
+    stay in-range for B ≤ 32767 lanes — far above any single-device
+    batch."""
+    onf = pf_on
+    w = onf.astype(jnp.int32)
+    n = w.sum()
+
+    def s64(x, wm):
+        # (hi_sum, lo_sum) over masked lanes; value = hi*65536 + lo
+        xm = x * wm
+        return jnp.stack([(xm >> 16).sum(0), (xm & 0xFFFF).sum(0)])
+
+    def pcts(x):
+        v = jnp.sort(jnp.where(onf, x, jnp.int32(2**31 - 1)))
+
+        def at(q):
+            i = jnp.clip((jnp.maximum(n, 1) - 1) * q // 100,
+                         0, x.shape[0] - 1)
+            return v[i]
+        # all-masked batches read the +inf fill — report 0, not sentinel
+        return jnp.where(n > 0, jnp.stack([at(50), at(90), at(100)]), 0)
+
+    return dict(
+        lanes=n,
+        dispatch=s64(pf_dispatch, w[:, None, None]),   # [2, N, K]
+        busy=s64(pf_busy, w[:, None]),                 # [2, N]
+        kill=s64(pf_kill, w[:, None]),                 # [2, N]
+        restart=s64(pf_restart, w[:, None]),           # [2, N]
+        drop=s64(pf_drop, w),
+        delay=s64(pf_delay, w),
+        now_sum=s64(now, w),
+        steps_sum=s64(steps, w),
+        # per-lane [p50, p90, max] over profiled lanes
+        qmax_pct=pcts(pf_qmax),
+        steps_pct=pcts(steps),
+        now_pct=pcts(now),
+        # per-lane busy total for the percentile only: float32 sum
+        # clipped below int32 max — N saturated per-node counters would
+        # wrap an int32 per-lane sum (the percentile is a distribution
+        # readout, exactness lives in the `busy` sums above)
+        busy_total_pct=pcts(jnp.clip(
+            pf_busy.astype(jnp.float32).sum(-1), 0,
+            float(2**31 - 256)).astype(jnp.int32)),
+    )
+
+
+def profile_digest(state):
+    """Launch the device-side profiler reduction over a batched state;
+    returns DEVICE arrays (a dict — JAX async dispatch, force lazily)
+    or None when the counter plane is compiled out (cfg.profile=False)
+    or the state is unbatched. O(counters) crosses the host boundary
+    when the caller materializes it, never the [B] lanes."""
+    pf = getattr(state, "pf_busy", None)
+    if pf is None or pf.ndim != 2 or pf.shape[1] == 0:
+        return None
+    return _profile_digest(state.pf_dispatch, state.pf_busy, state.pf_kill,
+                           state.pf_restart, state.pf_qmax, state.pf_drop,
+                           state.pf_delay, state.pf_on, state.steps,
+                           state.now)
+
+
+def profile_counters(state) -> dict | None:
+    """Materialize `profile_digest` host-side: plain numpy/int values
+    (the split 16-bit half-sums recombined into exact int64s), None
+    when the plane is compiled out. The raw-counter half of the
+    profiler report — `obs.profiler.profile_summary` derives the
+    human-facing rates (busy%, drop rate, mean delay) from it."""
+    d = profile_digest(state)
+    if d is None:
+        return None
+    d = {k: np.asarray(v) for k, v in d.items()}
+
+    def wide(a):        # hi·2^16 + lo — exact, however big the batch sum
+        a = a.astype(np.int64)
+        return a[0] * 65536 + a[1]
+
+    return dict(
+        lanes=int(d["lanes"]),
+        dispatch=wide(d["dispatch"]),
+        busy=wide(d["busy"]), kill=wide(d["kill"]),
+        restart=wide(d["restart"]),
+        drop=int(wide(d["drop"])), delay=int(wide(d["delay"])),
+        now_sum=int(wide(d["now_sum"])),
+        steps_sum=int(wide(d["steps_sum"])),
+        qmax_p50=int(d["qmax_pct"][0]), qmax_p90=int(d["qmax_pct"][1]),
+        qmax_max=int(d["qmax_pct"][2]),
+        steps_p50=int(d["steps_pct"][0]), steps_p90=int(d["steps_pct"][1]),
+        steps_max=int(d["steps_pct"][2]),
+        now_p50=int(d["now_pct"][0]), now_p90=int(d["now_pct"][1]),
+        now_max=int(d["now_pct"][2]),
+        busy_total_p50=int(d["busy_total_pct"][0]),
+        busy_total_p90=int(d["busy_total_pct"][1]),
+        busy_total_max=int(d["busy_total_pct"][2]),
+    )
+
+
 def schedule_representatives(state, seeds) -> dict:
     """{sched_hash: first seed that produced it} — one replayable
     representative per distinct interleaving class. After a sweep, replay
@@ -250,5 +362,22 @@ def summarize(rt, state, seeds=None) -> dict:
         # interleaving classes; first_divergence says how early the
         # batch bought them.
         first_divergence=divergence_profile(state),
+        # where the cluster spent its effort (r15): the counter-plane
+        # rollup — None when cfg.profile is off. Arrays summarized to
+        # lists so the report stays JSON-able like everything else.
+        profile=_profile_brief(state),
         oops=int((np.asarray(state.oops) != 0).sum()),
     )
+
+
+def _profile_brief(state) -> dict | None:
+    c = profile_counters(state)
+    if c is None:
+        return None
+    return dict(
+        lanes=c["lanes"],
+        dispatch_by_node=c["dispatch"].sum(-1).tolist(),
+        busy_by_node=c["busy"].tolist(),
+        kills=int(c["kill"].sum()), restarts=int(c["restart"].sum()),
+        drops=c["drop"], delay_ticks=c["delay"],
+        qmax_p50=c["qmax_p50"], qmax_max=c["qmax_max"])

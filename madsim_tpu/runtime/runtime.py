@@ -201,7 +201,24 @@ class Runtime:
             t_tag=jnp.asarray(tag), t_payload=jnp.asarray(payload))
 
     # ------------------------------------------------------------------
-    def init_batch(self, seeds, trace_lanes=None) -> SimState:
+    @staticmethod
+    def _lane_mask(lanes, B: int, what: str) -> np.ndarray:
+        """Normalize a lane selection (int index array or bool[B] mask)
+        into a bool[B] mask — shared by the trace_lanes and
+        profile_lanes sampling knobs."""
+        lanes = np.asarray(lanes)
+        if lanes.dtype == bool:
+            if lanes.shape != (B,):
+                raise ValueError(
+                    f"bool {what} mask shape {lanes.shape} != "
+                    f"batch ({B},)")
+            return lanes
+        mask = np.zeros(B, bool)
+        mask[lanes.astype(np.int64)] = True
+        return mask
+
+    def init_batch(self, seeds, trace_lanes=None,
+                   profile_lanes=None) -> SimState:
         """Initial batched state for an array of seeds (replay-by-seed:
         the same seed always reproduces the same trajectory, the
         MADSIM_TEST_SEED contract of macros lib.rs:141-145).
@@ -212,6 +229,12 @@ class Runtime:
         sweep record 8 lanes instead of paying ring bandwidth on all of
         them). Lanes, not seeds: sampling is a property of this batch's
         layout, and obs/rings.py readers take lane indices too.
+
+        profile_lanes: which lanes the sim-profiler counter plane counts
+        when cfg.profile (None = all; same index/bool-mask forms). The
+        masked-off build is the ship-with-it shape: profile=True
+        compiled in, lanes flipped on only for the sweeps being
+        profiled (bench.py --mode prof_ab bounds the masked cost).
         """
         seeds = jnp.atleast_1d(jnp.asarray(seeds, jnp.uint32))
         keys = jax.vmap(prng.seed_key)(seeds)
@@ -224,18 +247,18 @@ class Runtime:
                 raise ValueError(
                     "trace_lanes given but cfg.trace_cap == 0 — the ring "
                     "is compiled out; set SimConfig(trace_cap=...) > 0")
-            B = int(seeds.shape[0])
-            lanes = np.asarray(trace_lanes)
-            if lanes.dtype == bool:
-                if lanes.shape != (B,):
-                    raise ValueError(
-                        f"bool trace_lanes mask shape {lanes.shape} != "
-                        f"batch ({B},)")
-                mask = lanes
-            else:
-                mask = np.zeros(B, bool)
-                mask[lanes.astype(np.int64)] = True
+            mask = self._lane_mask(trace_lanes, int(seeds.shape[0]),
+                                   "trace_lanes")
             batched = batched.replace(trace_on=jnp.asarray(mask))
+        if profile_lanes is not None:
+            if not self.cfg.profile:
+                raise ValueError(
+                    "profile_lanes given but cfg.profile is False — the "
+                    "counter plane is compiled out; set "
+                    "SimConfig(profile=True)")
+            mask = self._lane_mask(profile_lanes, int(seeds.shape[0]),
+                                   "profile_lanes")
+            batched = batched.replace(pf_on=jnp.asarray(mask))
         return batched
 
     def init_single(self, seed: int) -> SimState:

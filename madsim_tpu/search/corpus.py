@@ -44,7 +44,12 @@ from __future__ import annotations
 import numpy as np
 
 from ..parallel.stats import first_divergence_slots
-from .mutate import KnobPlan
+from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
+
+# op_yield's attribution buckets: one per havoc operator, plus "base"
+# for admitted lanes no operator touched (bootstrap / fresh-floor lanes
+# and mutants whose every draw was guarded into a no-op)
+YIELD_NAMES = OP_NAMES + ("base",)
 
 # entry id = (worker_id << _ID_SHIFT) | per-worker monotonic counter.
 # 2^40 admissions per worker and 2^23 workers fit int64 with headroom.
@@ -185,17 +190,44 @@ class Corpus:
             self.entries[j] = entry
 
     # ------------------------------------------------------------------
+    def energy_summary(self) -> dict:
+        """The corpus's energy distribution — where the scheduler's
+        mutation budget is concentrated (fuzz_round records carry it):
+        entry count, total/mean/percentile energies, and how many live
+        entries came from crashing lanes."""
+        if not self.entries:
+            return dict(entries=0)
+        en = np.asarray([e["energy"] for e in self.entries])
+        return dict(
+            entries=len(self.entries),
+            total=round(float(en.sum()), 3),
+            mean=round(float(en.mean()), 3),
+            p50=round(float(np.percentile(en, 50)), 3),
+            p90=round(float(np.percentile(en, 90)), 3),
+            max=round(float(en.max()), 3),
+            crash_entries=sum(1 for e in self.entries
+                              if e.get("crash_code", 0)))
+
+    # ------------------------------------------------------------------
     def observe(self, knobs_batch, seeds, hashes_u64, crashed, codes,
-                parent_ids, round_no: int, sketches=None) -> dict:
+                parent_ids, round_no: int, sketches=None,
+                last_op=None) -> dict:
         """Fold one harvested round into the corpus. `knobs_batch` is the
         HOST knob batch that ran, `hashes_u64` the per-lane schedule
         hashes, `parent_ids` the corpus entry id each lane mutated from
         (schedule()'s ids; -1 for base/bootstrap lanes), `sketches` the
         optional [B, S] prefix-coverage sketch batch (SimState.cov_sketch
-        — enables the early-divergence admission bonus). Returns
-        admission stats."""
+        — enables the early-divergence admission bonus), `last_op` the
+        optional int[B] per-lane LAST applied havoc operator
+        (KnobPlan.mutate's third output; -1 = untouched). Returns
+        admission stats; with `last_op` given they include `op_yield` —
+        admissions attributed by operator (int64[N_MUT_OPS + 1], last
+        slot = "base"), summing exactly to `new`: which operators'
+        mutants actually bought coverage, not just which ran."""
         new = 0
         new_crash_codes = []
+        op_yield = (np.zeros(N_MUT_OPS + 1, np.int64)
+                    if last_op is not None else None)
         div_slot = None
         n_slots = 0
         if sketches is not None:
@@ -224,6 +256,9 @@ class Corpus:
                 continue
             self._seen.add(h)
             new += 1
+            if op_yield is not None:
+                o = int(last_op[i])
+                op_yield[o if 0 <= o < N_MUT_OPS else N_MUT_OPS] += 1
             energy = 3.0 if hit_crash else 1.0
             slot = None
             if div_slot is not None:
@@ -246,8 +281,11 @@ class Corpus:
             if parent is not None:
                 parent["energy"] = min(
                     self.energy_cap, parent["energy"] * self.reward)
-        return dict(new=new, size=len(self.entries),
-                    new_crash_codes=new_crash_codes)
+        out = dict(new=new, size=len(self.entries),
+                   new_crash_codes=new_crash_codes)
+        if op_yield is not None:
+            out["op_yield"] = op_yield
+        return out
 
     # ------------------------------------------------------------------
     def schedule(self, batch: int):

@@ -45,7 +45,7 @@ import jax
 import numpy as np
 
 from ..parallel import stats
-from .corpus import Corpus
+from .corpus import Corpus, YIELD_NAMES
 from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
 
 # seed-space stride between workers sharing a corpus dir: worker w's round
@@ -140,6 +140,10 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     """
     plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
     op_hist = np.zeros(N_MUT_OPS, np.int64)
+    # cumulative coverage-YIELD attribution (vs op_hist's application
+    # counts): admissions credited to the admitted lane's last applied
+    # operator, "+1" slot = base/untouched lanes (search/corpus.py)
+    yield_hist = np.zeros(N_MUT_OPS + 1, np.int64)
     if verify_resume is None:
         verify_resume = _env_verify_resume()
     store = buckets = None
@@ -186,6 +190,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         wall_prior = float(ws.get("wall_s", 0.0))
         if ws.get("op_hist"):
             op_hist[:] = np.asarray(ws["op_hist"], np.int64)
+        if ws.get("op_yield"):
+            yield_hist[:] = np.asarray(ws["op_yield"], np.int64)
     if corpus is None:
         corpus = Corpus(plan, rng=np.random.default_rng(rng_seed),
                         fresh_frac=fresh_frac,
@@ -210,16 +216,18 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             knobs_dev = {k: v for k, v in plan.base_batch(batch).items()}
             ids = np.full(batch, -1, np.int64)
             hist = None
+            last_op = np.full(batch, -1, np.int64)
         else:
             parents, ids = corpus.schedule(batch)
-            knobs_dev, hist = plan.mutate(parents, jax.random.fold_in(
-                master, np.uint32(r)), havoc=havoc)
+            knobs_dev, hist, last_op = plan.mutate(
+                parents, jax.random.fold_in(master, np.uint32(r)),
+                havoc=havoc)
         state = plan.apply(rt.init_batch(seeds), knobs_dev)
         if fused:
             state = rt.run_fused(state, max_steps, chunk)
         else:
             state, _ = rt.run(state, max_steps, chunk)
-        return seeds, ids, knobs_dev, hist, state
+        return seeds, ids, knobs_dev, hist, last_op, state
 
     def harvest(launched):
         """Block on one round. Transfers the [B] hash/crash lanes plus
@@ -227,7 +235,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         attribution, unlike explore()'s O(distinct) digest) and, when
         the build compiles the prefix sketch in, the [B, S] sketch
         batch (also kilobytes — the divergence-depth signal)."""
-        seeds, ids, knobs_dev, hist, state = launched
+        seeds, ids, knobs_dev, hist, last_op, state = launched
         knobs_host = {k: np.asarray(v) for k, v in knobs_dev.items()}
         hashes = stats.sched_hash_u64(state)
         sk = np.asarray(state.cov_sketch)
@@ -236,7 +244,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             op_hist[:] += np.asarray(hist)
         return (seeds, ids, knobs_host, hashes,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
-                hist is not None, sketches, state)
+                hist is not None, np.asarray(last_op), sketches, state)
 
     def verified(harvested):
         """The run-twice resume guard (verify_resume): re-dispatch the
@@ -248,13 +256,13 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         from ..utils.verify import agree_twice
 
         def key_of(h):
-            _, _, _, hashes, crashed, codes, _, sketches, _ = h
+            _, _, _, hashes, crashed, codes, _, _, sketches, _ = h
             return (hashes.tobytes(), crashed.tobytes(), codes.tobytes(),
                     None if sketches is None else sketches.tobytes())
 
         def again(prev):
             seeds, ids, knobs_host = prev[0], prev[1], prev[2]
-            mutated = prev[6]
+            mutated, last_op = prev[6], prev[7]
             state = plan.apply(rt.init_batch(seeds), knobs_host)
             if fused:
                 state = rt.run_fused(state, max_steps, chunk)
@@ -262,7 +270,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 state, _ = rt.run(state, max_steps, chunk)
             return harvest((seeds, ids, knobs_host,
                             None if not mutated else
-                            np.zeros(N_MUT_OPS, np.int64), state))
+                            np.zeros(N_MUT_OPS, np.int64), last_op, state))
 
         return agree_twice(harvested, again, key_of,
                            what="first post-resume campaign round")
@@ -295,10 +303,12 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         if r == verify_round:
             harvested = verified(harvested)
         (seeds, ids, knobs_host, hashes, crashed, codes,
-         mutated, sketches, state) = harvested
+         mutated, last_op, sketches, state) = harvested
         rounds += 1
         cstats = corpus.observe(knobs_host, seeds, hashes, crashed, codes,
-                                ids, r, sketches=sketches)
+                                ids, r, sketches=sketches,
+                                last_op=last_op)
+        yield_hist[:] += cstats["op_yield"]
         for i in np.nonzero(crashed)[0]:
             c = int(codes[i])
             if not mutated:     # seed-alone handles: bootstrap lanes only
@@ -338,6 +348,16 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 distinct_total=len(seen), crashes=n_crashed,
                 corpus_size=cstats["size"],
                 new_crash_codes=cstats["new_crash_codes"],
+                # coverage-yield attribution (r15): the round's
+                # admissions credited to the operator that produced
+                # each admitted mutant (sums to `admitted`; "base" =
+                # untouched lanes), plus where the corpus's mutation
+                # budget sits — the fuzzer-effectiveness half of the
+                # profiler plane
+                admitted=cstats["new"],
+                op_yield={YIELD_NAMES[i]: int(cstats["op_yield"][i])
+                          for i in range(len(YIELD_NAMES))},
+                corpus_energy=corpus.energy_summary(),
                 dry_rounds=dry, wall_s=time.perf_counter() - t0)
             if buckets is not None:
                 rec["buckets_opened"] = len(opened_buckets)
@@ -354,10 +374,21 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 or dry >= dry_rounds or r + 1 == max_rounds):
             # the durability point: after observe/buckets, BEFORE the
             # next round's schedule draw — a resume restores the rng
-            # state saved here and replays that draw identically
+            # state saved here and replays that draw identically.
+            # The campaign-timeline row goes FIRST: a kill between the
+            # two re-runs the round and re-appends an identical row
+            # (deduped by rounds_done in campaign_timeline), so the
+            # durable timeline has no gaps and no double counts
+            wall_now = wall_prior + time.perf_counter() - t0
+            store.append_metrics(worker_id, dict(
+                t=time.time(), worker=worker_id, rounds_done=r + 1,
+                coverage=len(seen), seeds_run=(r + 1) * batch,
+                crashes=n_crashed, corpus_size=len(corpus),
+                dry=dry, wall_s=round(wall_now, 3),
+                op_yield=[int(x) for x in yield_hist]))
             store.sync(corpus, worker_id, rounds_done=r + 1, dry=dry,
-                       op_hist=op_hist,
-                       wall_s=wall_prior + time.perf_counter() - t0)
+                       op_hist=op_hist, op_yield=yield_hist,
+                       wall_s=wall_now)
         if dry >= dry_rounds:
             break
         pending = nxt if nxt is not None else (
@@ -375,6 +406,13 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         corpus_size=len(corpus),
         mutation_ops={OP_NAMES[i]: int(op_hist[i])
                       for i in range(N_MUT_OPS)},
+        # campaign-cumulative coverage yield by operator (the
+        # effectiveness view op_hist's application counts cannot give:
+        # an operator that runs constantly but never buys coverage
+        # shows up here as 0)
+        mutation_yield={YIELD_NAMES[i]: int(yield_hist[i])
+                        for i in range(len(YIELD_NAMES))},
+        corpus_energy=corpus.energy_summary(),
     )
     if store is not None:
         result.update(

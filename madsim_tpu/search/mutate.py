@@ -165,12 +165,16 @@ class KnobPlan:
         """Derive a batch of mutants: per lane, `havoc` stacked operators
         drawn uniformly (the AFL havoc stage, vectorized). `knobs_batch`
         is host or device arrays [B, ...]; `key` one PRNG key. Returns
-        (device knob batch, int32[N_MUT_OPS] operator histogram).
+        (device knob batch, int32[N_MUT_OPS] operator histogram,
+        int32[B] per-lane LAST applied operator — -1 when no operator
+        landed; the coverage-yield attribution handle, search/fuzz.py).
         havoc=0 is the degenerate identity (the blind-sampling control:
         fuzz(havoc=0) reduces to explore() with knob plumbing)."""
         kb = {k: jnp.asarray(v) for k, v in knobs_batch.items()}
         if havoc <= 0:
-            return kb, jnp.zeros((N_MUT_OPS,), jnp.int32)
+            B = int(kb["row_time"].shape[0])
+            return (kb, jnp.zeros((N_MUT_OPS,), jnp.int32),
+                    jnp.full((B,), -1, jnp.int32))
         return _mutate_batch(kb, key, self._guards(), havoc)
 
     def mutate_masked(self, knobs_batch, key, mask, havoc: int = 3):
@@ -185,10 +189,13 @@ class KnobPlan:
         (same key split, same operators; the selects are identity), so
         the 1-shard campaign stays bit-identical to the unsharded
         fuzzer. Returns (device knob batch, histogram over MASKED lanes
-        only — a passed-through lane's draws never count)."""
+        only — a passed-through lane's draws never count, and its
+        last-op attribution is -1 like an unmutated lane's)."""
         kb = {k: jnp.asarray(v) for k, v in knobs_batch.items()}
         if havoc <= 0:
-            return kb, jnp.zeros((N_MUT_OPS,), jnp.int32)
+            B = int(kb["row_time"].shape[0])
+            return (kb, jnp.zeros((N_MUT_OPS,), jnp.int32),
+                    jnp.full((B,), -1, jnp.int32))
         return _mutate_batch_masked(kb, key, self._guards(), havoc,
                                     jnp.asarray(mask))
 
@@ -273,6 +280,7 @@ def _mutate_one(kn, key, g, havoc):
     D = kn["dup_src"].shape[0]
     N = g["pool_ok"].shape[1] - 1
     hist = jnp.zeros((N_MUT_OPS,), jnp.int32)
+    last_op = jnp.asarray(-1, jnp.int32)
     for k in prng.split(key, havoc):
         ks = prng.split(k, 12)
         op = prng.randint(ks[0], 0, N_MUT_OPS - 1)
@@ -361,7 +369,12 @@ def _mutate_one(kn, key, g, havoc):
                    | ((op == 2) & ok_d) | ((op == 3) & dup_eff) | (op >= 4))
         hist = hist + ((jnp.arange(N_MUT_OPS, dtype=jnp.int32) == op)
                        & applied).astype(jnp.int32)
-    return kn, hist
+        # the lane's LAST applied operator: the coverage-yield
+        # attribution handle (search/fuzz.py) — when this lane's mutant
+        # is admitted, exactly one operator gets the credit, so
+        # per-operator yield sums to the round's admissions
+        last_op = jnp.where(applied, op, last_op)
+    return kn, hist, last_op
 
 
 @functools.partial(jax.jit, static_argnames=("havoc",))
@@ -370,9 +383,9 @@ def _mutate_batch(knobs, key, guards, havoc):
                            batch=int(knobs["row_time"].shape[0]),
                            havoc=havoc)
     keys = jax.random.split(key, knobs["row_time"].shape[0])
-    out, hist = jax.vmap(_mutate_one, in_axes=(0, 0, None, None))(
+    out, hist, last_op = jax.vmap(_mutate_one, in_axes=(0, 0, None, None))(
         knobs, keys, guards, havoc)
-    return out, hist.sum(0)
+    return out, hist.sum(0), last_op
 
 
 @functools.partial(jax.jit, static_argnames=("havoc",))
@@ -381,7 +394,7 @@ def _mutate_batch_masked(knobs, key, guards, havoc, mask):
                            batch=int(knobs["row_time"].shape[0]),
                            havoc=havoc)
     keys = jax.random.split(key, knobs["row_time"].shape[0])
-    out, hist = jax.vmap(_mutate_one, in_axes=(0, 0, None, None))(
+    out, hist, last_op = jax.vmap(_mutate_one, in_axes=(0, 0, None, None))(
         knobs, keys, guards, havoc)
 
     def sel(new, old):
@@ -389,7 +402,8 @@ def _mutate_batch_masked(knobs, key, guards, havoc, mask):
                          new, old)
 
     return ({k: sel(out[k], knobs[k]) for k in knobs},
-            (hist * mask[:, None]).sum(0))
+            (hist * mask[:, None]).sum(0),
+            jnp.where(mask, last_op, jnp.asarray(-1, jnp.int32)))
 
 
 @functools.partial(jax.jit, static_argnames=("n_init", "jitter_gate"))

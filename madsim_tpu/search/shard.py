@@ -64,7 +64,7 @@ import numpy as np
 
 from ..parallel import stats
 from ..parallel.mesh import SEED_AXIS, seed_mesh
-from .corpus import Corpus, merge_consensus
+from .corpus import Corpus, YIELD_NAMES, merge_consensus
 from .fuzz import WORKER_SEED_STRIDE, _env_verify_resume
 from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
 
@@ -135,6 +135,7 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
     # by lane position and the 1-shard stream equals fuzz()'s
     master = jax.random.PRNGKey(np.uint32(rng_seed ^ 0x5EED5EED))
     op_hist = np.zeros(N_MUT_OPS, np.int64)
+    yield_hist = np.zeros(N_MUT_OPS + 1, np.int64)   # see fuzz()
     if verify_resume is None:
         verify_resume = _env_verify_resume()
 
@@ -179,6 +180,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         wall_prior = float(group.get("wall_s", 0.0)) if group else 0.0
         if group and group.get("op_hist"):
             op_hist[:] = np.asarray(group["op_hist"], np.int64)
+        if group and group.get("op_yield"):
+            yield_hist[:] = np.asarray(group["op_yield"], np.int64)
         shard_states = group.get("shard_states") if group else None
         corpora = []
         for s in range(S):
@@ -251,12 +254,13 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             mask = jax.device_put(
                 np.repeat(np.asarray(mutated, bool), batch),
                 lane_sharding)
-            knobs_dev, hist = plan.mutate_masked(
+            knobs_dev, hist, last_op = plan.mutate_masked(
                 parents_global,
                 jax.random.fold_in(master, np.uint32(r)), mask,
                 havoc=havoc)
         else:
             knobs_dev, hist = parents_global, None
+            last_op = np.full(batch * S, -1, np.int64)
         # init on the default device, then place lanes over the mesh
         # BEFORE the knob write, so apply_knobs runs SPMD per shard
         from ..parallel.mesh import shard_batch
@@ -273,13 +277,14 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         # the all-gathered O(distinct) coverage digest (queued async):
         # campaign-global dedup without shipping [S*B] hashes per round
         pairs, n = stats.coverage_digest(state)
-        return seeds, ids, knobs_dev, hist, mutated, state, pairs, n
+        return seeds, ids, knobs_dev, hist, last_op, mutated, state, pairs, n
 
     def harvest(launched):
         """Block on one round. Per-shard corpora read their own [batch]
         hash/crash/knob lanes (kilobytes per shard — the same bill
         fuzz() pays); the global dedup reads only the digest prefix."""
-        seeds, ids, knobs_dev, hist, mutated, state, pairs, n = launched
+        (seeds, ids, knobs_dev, hist, last_op, mutated, state,
+         pairs, n) = launched
         knobs_host = {k: np.asarray(v) for k, v in knobs_dev.items()}
         hashes = stats.sched_hash_u64(state)
         digest = stats.digest_hashes(pairs, n)
@@ -289,7 +294,7 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             op_hist[:] += np.asarray(hist)
         return (seeds, ids, knobs_host, hashes, digest,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
-                mutated, sketches, state)
+                mutated, np.asarray(last_op), sketches, state)
 
     def do_merge():
         """The cross-shard exchange: admissions since the last merge
@@ -318,10 +323,20 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         for s in range(S):
             merged += stores[s].merge_foreign(corpora[s])
             stores[s].persist_entries(corpora[s], eff_w[s])
+        # timeline row BEFORE the group commit (fuzz()'s ordering: a
+        # kill between the two re-appends an identical row on resume;
+        # campaign_timeline dedups by rounds_done)
+        stores[0].append_metrics(worker_id, dict(
+            t=time.time(), worker=worker_id, shards=S,
+            rounds_done=rounds_done, coverage=len(seen),
+            seeds_run=rounds_done * batch * S, crashes=n_crashed,
+            corpus_size=sum(len(c) for c in corpora),
+            dry=dry_now, wall_s=round(wall_s, 3),
+            op_yield=[int(x) for x in yield_hist]), group=True)
         stores[0].write_shard_group_state(
             corpora, worker_id=worker_id, shards=S,
             rounds_done=rounds_done, dry=dry_now, op_hist=op_hist,
-            wall_s=wall_s, tally=tally)
+            wall_s=wall_s, tally=tally, op_yield=yield_hist)
         return merged
 
     # global coverage frontier: on resume, the union of every shard's
@@ -360,18 +375,20 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             harvested = _verified_harvest(
                 rt, plan, harvested, harvest, max_steps, chunk, fused, mesh)
         (seeds, ids, knobs_host, hashes, digest, crashed, codes,
-         mutated, sketches, state) = harvested
+         mutated, last_op, sketches, state) = harvested
         rounds += 1
         corpus_size = 0
         per_shard_rows = []
         round_new_codes: list[int] = []
+        round_yield = np.zeros(N_MUT_OPS + 1, np.int64)
         for s in range(S):
             lo, hi = s * batch, (s + 1) * batch
             sk_s = sketches[lo:hi] if sketches is not None else None
             cstats = corpora[s].observe(
                 {k: v[lo:hi] for k, v in knobs_host.items()},
                 seeds[lo:hi], hashes[lo:hi], crashed[lo:hi], codes[lo:hi],
-                ids[lo:hi], r, sketches=sk_s)
+                ids[lo:hi], r, sketches=sk_s, last_op=last_op[lo:hi])
+            round_yield += cstats["op_yield"]
             shard_seen[s] |= set(hashes[lo:hi].tolist())
             corpus_size += cstats["size"]
             shard_crashes[s] += int(crashed[lo:hi].sum())
@@ -386,8 +403,15 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 corpus_size=cstats["size"],
                 coverage=len(shard_seen[s]),
                 new=cstats["new"],
+                # per-shard operator yield: this shard's admissions by
+                # producing operator (ProgressObserver renders the top)
+                op_yield={YIELD_NAMES[i]: int(cstats["op_yield"][i])
+                          for i in range(len(YIELD_NAMES))
+                          if cstats["op_yield"][i]},
+                energy=corpora[s].energy_summary(),
                 crashes=int(crashed[lo:hi].sum()),
                 seeds_run=rounds * batch))
+        yield_hist[:] += round_yield
         for i in np.nonzero(crashed)[0]:
             c = int(codes[i])
             if not mutated[int(i) // batch]:
@@ -422,6 +446,12 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 corpus_size=corpus_size,
                 new_crash_codes=round_new_codes,
                 per_shard=per_shard_rows,
+                # campaign-wide admissions + yield this round (the
+                # per-shard split rides in per_shard): sums over shards,
+                # so the per-operator counts still sum to `admitted`
+                admitted=int(round_yield.sum()),
+                op_yield={YIELD_NAMES[i]: int(round_yield[i])
+                          for i in range(len(YIELD_NAMES))},
                 dry_rounds=dry, wall_s=time.perf_counter() - t0)
             if buckets is not None:
                 rec["buckets_opened"] = len(opened_buckets)
@@ -465,6 +495,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                    for s in range(S)],
         mutation_ops={OP_NAMES[i]: int(op_hist[i])
                       for i in range(N_MUT_OPS)},
+        mutation_yield={YIELD_NAMES[i]: int(yield_hist[i])
+                        for i in range(len(YIELD_NAMES))},
     )
     if stores is not None:
         result.update(
@@ -515,16 +547,17 @@ def _verified_harvest(rt, plan, harvested, harvest_fn, max_steps, chunk,
     from ..utils.verify import agree_twice
 
     def key_of(h):
-        _, _, _, hashes, digest, crashed, codes, _, sketches, _ = h
+        _, _, _, hashes, digest, crashed, codes, _, _, sketches, _ = h
         return (hashes.tobytes(), crashed.tobytes(), codes.tobytes(),
                 None if sketches is None else sketches.tobytes())
 
     def again(prev):
         # prev is a HARVESTED tuple: (seeds, ids, knobs_host, hashes,
-        # digest, crashed, codes, mutated, sketches, state). The knob
-        # batch was never donated, so re-placing the host copy over the
-        # mesh re-dispatches the identical round.
+        # digest, crashed, codes, mutated, last_op, sketches, state).
+        # The knob batch was never donated, so re-placing the host copy
+        # over the mesh re-dispatches the identical round.
         seeds, ids, knobs_host, mutated = prev[0], prev[1], prev[2], prev[7]
+        last_op = prev[8]
         sharding = NamedSharding(mesh, P(SEED_AXIS))
         knobs_dev = {k: jax.device_put(v, sharding)
                      for k, v in knobs_host.items()}
@@ -539,7 +572,7 @@ def _verified_harvest(rt, plan, harvested, harvest_fn, max_steps, chunk,
         else:
             state, _ = rt.run(state, max_steps, chunk)
         pairs, n = stats.coverage_digest(state)
-        return harvest_fn((seeds, ids, knobs_dev, None,
+        return harvest_fn((seeds, ids, knobs_dev, None, last_op,
                            mutated, state, pairs, n))
 
     return agree_twice(harvested, again, key_of,

@@ -13,15 +13,15 @@ See DESIGN.md §13 "Persistence discipline".
 """
 
 from .buckets import CrashBuckets, merged_buckets
-from .campaign import (campaign_report, campaign_stats, prune_cold_entries,
-                       replay_bucket, run_campaign, spawn_worker,
-                       supervise_campaign, worker_cmd)
+from .campaign import (campaign_report, campaign_stats, campaign_timeline,
+                       prune_cold_entries, replay_bucket, run_campaign,
+                       spawn_worker, supervise_campaign, worker_cmd)
 from .store import CorpusStore, StoreMismatch, store_signature
 
 __all__ = [
     "CorpusStore", "StoreMismatch", "store_signature",
     "CrashBuckets", "merged_buckets",
     "run_campaign", "supervise_campaign", "prune_cold_entries",
-    "campaign_report", "campaign_stats", "spawn_worker",
-    "worker_cmd", "replay_bucket",
+    "campaign_report", "campaign_stats", "campaign_timeline",
+    "spawn_worker", "worker_cmd", "replay_bucket",
 ]

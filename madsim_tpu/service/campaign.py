@@ -28,6 +28,8 @@ import subprocess
 import sys
 import time
 
+import numpy as np
+
 from .buckets import merged_buckets
 from .store import CorpusStore
 
@@ -195,20 +197,111 @@ def run_campaign(factory: str, corpus_dir: str, *, workers: int = 2,
                            workers=workers, worker_results=results)
 
 
+def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
+                      now: float | None = None) -> dict:
+    """Fold the workers' durable `metrics/` rows (appended at every
+    durability sync, fsync'd — search/fuzz.py) into the campaign's
+    after-the-fact telemetry. No live poller required: a finished or
+    killed campaign is inspectable from the dir alone.
+
+    Rows are deduped by (worker, rounds_done) keeping the LAST
+    occurrence — a killed-and-resumed worker re-appends its interrupted
+    sync's row with identical content (the append-before-commit
+    ordering), so the folded timeline has no double-counted rounds and
+    no gaps. Returns:
+
+      timeline         all rows, deduped, time-ordered
+      coverage_curve   [[t_rel_s, coverage]] — campaign-global coverage
+                       over wall time (running max over workers' views)
+      rate_curve       [[t_rel_s, schedules_per_sec]] — coverage/wall
+                       at each sync
+      workers_health   {label: {last_seen, age_s, rounds_done, sync_gap_s,
+                       stale}} — `stale` means no row within
+                       `stale_after` × the worker's own observed sync
+                       cadence of the campaign's latest activity (`now`
+                       defaults to the newest row's timestamp, so a
+                       finished campaign reads healthy and a worker that
+                       died unresumed reads stale — its last counters
+                       are history, not current state)
+    """
+    by_worker = store.read_metrics()
+    rows = []
+    health = {}
+    for label, raw in by_worker.items():
+        dedup: dict[int, dict] = {}
+        for rec in raw:
+            dedup[int(rec.get("rounds_done", 0))] = rec
+        wrows = sorted(dedup.values(),
+                       key=lambda r: (r.get("t", 0.0),
+                                      r.get("rounds_done", 0)))
+        rows += [dict(r, worker=label) for r in wrows]
+        if wrows:
+            ts = [r.get("t", 0.0) for r in wrows]
+            gaps = [b - a for a, b in zip(ts, ts[1:]) if b > a]
+            health[label] = dict(
+                last_seen=ts[-1],
+                rounds_done=int(wrows[-1].get("rounds_done", 0)),
+                sync_gap_s=round(float(np.median(gaps)) if gaps else 0.0,
+                                 3))
+    rows.sort(key=lambda r: (r.get("t", 0.0), r.get("rounds_done", 0)))
+    t_ref = (now if now is not None
+             else max((r.get("t", 0.0) for r in rows), default=0.0))
+    for label, h in health.items():
+        h["age_s"] = round(max(t_ref - h["last_seen"], 0.0), 3)
+        # a worker with one row has no observed cadence — only flag it
+        # against cadences its peers establish
+        gap = h["sync_gap_s"] or max(
+            (g["sync_gap_s"] for g in health.values() if g["sync_gap_s"]),
+            default=0.0)
+        h["stale"] = bool(gap and h["age_s"] > stale_after * gap)
+    t0 = rows[0].get("t", 0.0) if rows else 0.0
+    coverage_curve = []
+    rate_curve = []
+    cov = 0
+    # schedules/s uses campaign_stats' denominator rule at each point in
+    # time: campaign coverage over the MAX of the workers' own wall
+    # accounts so far (workers run concurrently, their walls overlap) —
+    # dividing by the current ROW's wall would spike whenever a young
+    # worker's small wall met the campaign-global coverage
+    wall_by_worker: dict[str, float] = {}
+    for r in rows:
+        cov = max(cov, int(r.get("coverage", 0)))
+        t_rel = round(r.get("t", 0.0) - t0, 3)
+        coverage_curve.append([t_rel, cov])
+        if r.get("wall_s"):
+            wall_by_worker[r["worker"]] = max(
+                wall_by_worker.get(r["worker"], 0.0), float(r["wall_s"]))
+        wall = max(wall_by_worker.values(), default=0.0)
+        if wall:
+            rate_curve.append([t_rel, round(cov / wall, 2)])
+    return dict(timeline=rows, coverage_curve=coverage_curve,
+                rate_curve=rate_curve, workers_health=health)
+
+
 def campaign_report(corpus_dir: str, uptime_s: float = 0.0,
-                    workers: int = 0, worker_results: dict | None = None
-                    ) -> dict:
+                    workers: int = 0, worker_results: dict | None = None,
+                    stale_after: float = 3.0) -> dict:
     """The merged truth of a campaign dir: coverage, per-worker rounds,
     crash buckets AFTER the read-side suffix merge (so the count is
-    bugs, not bucket-open races)."""
+    bugs, not bucket-open races), and the durable timeline
+    (`campaign_timeline` — coverage/schedules-per-sec curves + per-worker
+    last-seen health, with stale workers FLAGGED rather than their last
+    counters silently reported as current)."""
     store = CorpusStore(corpus_dir, create=False)
     stats = campaign_stats(corpus_dir, uptime_s=uptime_s, workers=workers,
                            store=store)
     merged = merged_buckets(store)
     per_worker = {
         w: store.load_worker_state(w) for w in store.worker_ids()}
+    tl = campaign_timeline(store, stale_after=stale_after)
     return dict(
         stats,
+        timeline=tl["timeline"],
+        coverage_curve=tl["coverage_curve"],
+        rate_curve=tl["rate_curve"],
+        workers_health=tl["workers_health"],
+        stale_workers=sorted(w for w, h in tl["workers_health"].items()
+                             if h["stale"]),
         buckets_merged=len(merged),
         bucket_detail=[
             dict(key=m["key"], crash_code=m["crash_code"],

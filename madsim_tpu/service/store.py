@@ -47,6 +47,18 @@ Layout (one campaign = one directory):
                           bucketed crash observation; the bucket DIR is
                           the deduped truth, this is the rate telemetry)
   logs/w<w>.jsonl         per-worker SweepObserver records (fuzz rounds)
+  metrics/w<w>.jsonl      per-worker campaign-timeline rows (r15): one
+  metrics/g<w>.jsonl      append per durability sync (sharded groups use
+                          the g-prefix), fsync'd, carrying
+                          (t, rounds_done, coverage, seeds_run, crashes,
+                          corpus_size, wall_s, op_yield). Appended BEFORE
+                          the state sync, so a kill between the two
+                          re-appends an identical row on resume —
+                          `campaign_timeline` dedups by rounds_done, so
+                          the durable timeline has no gaps and no double
+                          counts, and a campaign is inspectable after
+                          the fact without a live poller
+                          (service/campaign.py `campaign_report`)
 
 Atomicity: every file is written to a `.tmp-<pid>` sibling and
 `os.replace`d into place, so a SIGKILL at any instant leaves either the
@@ -162,10 +174,11 @@ class CorpusStore:
         self.state_dir = os.path.join(self.dir, "state")
         self.buckets_dir = os.path.join(self.dir, "buckets")
         self.logs_dir = os.path.join(self.dir, "logs")
+        self.metrics_dir = os.path.join(self.dir, "metrics")
         manifest_path = os.path.join(self.dir, "MANIFEST.json")
         if create:
             for d in (self.entries_dir, self.state_dir, self.buckets_dir,
-                      self.logs_dir):
+                      self.logs_dir, self.metrics_dir):
                 os.makedirs(d, exist_ok=True)
         if os.path.exists(manifest_path):
             with open(manifest_path) as f:
@@ -238,6 +251,50 @@ class CorpusStore:
     def worker_log_path(self, worker_id: int) -> str:
         return os.path.join(self.logs_dir, f"w{worker_id:04d}.jsonl")
 
+    def metrics_path(self, worker_id: int, group: bool = False) -> str:
+        return os.path.join(self.metrics_dir,
+                            f"{'g' if group else 'w'}{worker_id:04d}.jsonl")
+
+    # -- campaign timeline (r15) ---------------------------------------
+    def append_metrics(self, worker_id: int, rec: dict,
+                       group: bool = False) -> None:
+        """Append one campaign-timeline row for this worker (fsync'd:
+        the timeline must be trustworthy under SIGKILL respawns —
+        single-line O_APPEND writes are atomic on POSIX at this size).
+        Called right BEFORE the state sync it describes; see the layout
+        docstring for the dedup contract that ordering buys."""
+        os.makedirs(self.metrics_dir, exist_ok=True)
+        with open(self.metrics_path(worker_id, group), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read_metrics(self) -> dict:
+        """{worker label: [timeline rows, file order]} for every worker
+        (and g<id> sharded group) that ever appended. Unparseable tail
+        lines (a torn write under power loss — O_APPEND makes this
+        unlikely, fsync ordering makes it harmless) are skipped."""
+        out: dict[str, list] = {}
+        try:
+            names = sorted(os.listdir(self.metrics_dir))
+        except FileNotFoundError:
+            return out
+        for n in names:
+            if not n.endswith(".jsonl") or _is_tmp(n):
+                continue
+            rows = []
+            with open(os.path.join(self.metrics_dir, n)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        continue
+            out[n[:-6]] = rows
+        return out
+
     # -- entries -------------------------------------------------------
     def write_entry(self, entry: dict) -> None:
         """Persist one corpus entry (immutable admission record). Safe to
@@ -301,7 +358,7 @@ class CorpusStore:
 
     def write_worker_state(self, corpus: Corpus, worker_id: int,
                            rounds_done: int, dry: int, op_hist,
-                           wall_s: float) -> None:
+                           wall_s: float, op_yield=None) -> None:
         self._write_own_entries(corpus, worker_id)
         _atomic_json(self.worker_state_path(worker_id), dict(
             worker_id=int(worker_id),
@@ -309,11 +366,14 @@ class CorpusStore:
             dry=int(dry),
             wall_s=float(wall_s),
             op_hist=[int(x) for x in np.asarray(op_hist)],
+            op_yield=(None if op_yield is None
+                      else [int(x) for x in np.asarray(op_yield)]),
             **self._scheduler_state(corpus)))
 
     def write_shard_group_state(self, corpora, worker_id: int, shards: int,
                                 rounds_done: int, dry: int, op_hist,
-                                wall_s: float, tally=None) -> None:
+                                wall_s: float, tally=None,
+                                op_yield=None) -> None:
         """Persist a sharded worker's WHOLE group as one atomic write:
         per-shard scheduler states (namespaced worker_id*shards+s), the
         shared round/dry/wall counters, and the cross-shard consensus
@@ -328,6 +388,8 @@ class CorpusStore:
             dry=int(dry),
             wall_s=float(wall_s),
             op_hist=[int(x) for x in np.asarray(op_hist)],
+            op_yield=(None if op_yield is None
+                      else [int(x) for x in np.asarray(op_yield)]),
             tally=(None if tally is None else
                    [sorted((int(v), int(c)) for v, c in s.items())
                     for s in tally]),
@@ -433,14 +495,14 @@ class CorpusStore:
         return admitted
 
     def sync(self, corpus: Corpus, worker_id: int, rounds_done: int,
-             dry: int, op_hist, wall_s: float) -> dict:
+             dry: int, op_hist, wall_s: float, op_yield=None) -> dict:
         """One durability point: merge other workers' new entries, then
         persist this worker's admissions and scheduler state. Called at
         round boundaries (fuzz(..., sync_every=)); everything between two
         syncs is re-derived deterministically on resume."""
         merged = self.merge_foreign(corpus)
         self.write_worker_state(corpus, worker_id, rounds_done, dry,
-                                op_hist, wall_s)
+                                op_hist, wall_s, op_yield=op_yield)
         return dict(merged_foreign=merged)
 
     # -- read-only reporting -------------------------------------------

@@ -115,7 +115,10 @@ def main(argv=None) -> int:
     # by a structurally different runtime
     store = CorpusStore(args.corpus_dir, signature=store_signature(
         rt, KnobPlan.from_runtime(rt)))
-    obs = JsonlObserver(store.worker_log_path(args.worker_id))
+    # fsync per record: under supervise_campaign respawns the observer
+    # log must be complete up to the last sync even across power loss —
+    # the r15 campaign timeline's trust anchor
+    obs = JsonlObserver(store.worker_log_path(args.worker_id), fsync=True)
     if args.progress:
         obs = TeeObserver(obs, ProgressObserver())
     dry = (args.dry_rounds if args.dry_rounds is not None

@@ -60,6 +60,12 @@ case "$tier" in
     # and a split 2-shard campaign must resume equal to the
     # uninterrupted control with the verify_resume guard armed
     python bench.py --shard-smoke
+    # sim-profiler smoke: on-device counters must match a host-replayed
+    # reference on a seeded chaos run, profiling on/off/masked must be
+    # bit-identical leaf-for-leaf, Perfetto counter tracks must export
+    # next to the instants, and fuzz rounds must report per-operator
+    # coverage yield summing to each round's admissions
+    python bench.py --prof-smoke
     # DetSan smoke: the repo-wide determinism lint gate must be clean,
     # a seeded schedule race must confirm via the forced-commute PCT
     # nudge with a replayable (seed, knobs, nudge) repro and dedupe

@@ -100,8 +100,8 @@ class TestStoreRoundTrip:
         for k in p1:
             assert (np.asarray(p1[k]) == np.asarray(p2[k])).all(), k
         key = jax.random.PRNGKey(3)
-        m1, h1 = plan.mutate(p1, key)
-        m2, h2 = plan.mutate(p2, key)
+        m1, h1, _ = plan.mutate(p1, key)
+        m2, h2, _ = plan.mutate(p2, key)
         assert (np.asarray(h1) == np.asarray(h2)).all()
         for k in m1:
             assert (np.asarray(m1[k]) == np.asarray(m2[k])).all(), k

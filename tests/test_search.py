@@ -97,8 +97,8 @@ class TestMutateApply:
                  plan.base_batch(batch).items()}
         key = jax.random.PRNGKey(7)
         for i in range(rounds):     # stack mutations to push extremes
-            knobs, _ = plan.mutate(knobs, jax.random.fold_in(key, i),
-                                   havoc=havoc)
+            knobs, _, _ = plan.mutate(knobs, jax.random.fold_in(key, i),
+                                      havoc=havoc)
         state = plan.apply(rt.init_batch(np.arange(batch)), knobs)
         return plan, knobs, state
 
@@ -163,8 +163,8 @@ class TestMutateApply:
                                      sync_wal=False, scenario=sc)
             steps = 20_000
         plan = KnobPlan.from_runtime(rt, dup_slots=2)
-        knobs, _ = plan.mutate(plan.base_batch(24), jax.random.PRNGKey(3),
-                               havoc=4)
+        knobs, _, _ = plan.mutate(plan.base_batch(24),
+                                  jax.random.PRNGKey(3), havoc=4)
         chunked, _ = rt.run(
             plan.apply(rt.init_batch(np.arange(24)), knobs), steps, 256)
         fused = rt.run_fused(
