@@ -439,7 +439,7 @@ def _sched_ab_mode():
 
 
 def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0,
-                        profile=False):
+                        profile=False, latency_hist=0):
     """A deliberately tiny workload (2-node ping-pong, C=16, P=2, stats
     off) for the fused A/B: per-step device compute is small, so the
     per-chunk host round-trip the chunked runner pays
@@ -450,11 +450,16 @@ def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0,
     recorder's relative overhead (--mode obs_ab): the ring write is a
     fixed per-step cost, so a tiny step magnifies it."""
     from madsim_tpu import Runtime, SimConfig, NetConfig, ms, sec
+    from madsim_tpu.core.types import EV_MSG
     from madsim_tpu.models.pingpong import PingPong, state_spec
     cfg = SimConfig(n_nodes=n_nodes, event_capacity=16, payload_words=2,
                     time_limit=sec(590), collect_stats=False,
                     trace_cap=trace_cap, sketch_slots=sketch_slots,
-                    profile=profile,
+                    profile=profile, latency_hist=latency_hist,
+                    # ping deliveries as completions so the lat_ab
+                    # variants pay the e2e fold, not just the sojourn
+                    complete_kinds=(((EV_MSG, 1),) if latency_hist
+                                    else ()),
                     net=NetConfig(packet_loss_rate=loss,
                                   send_latency_min=ms(1),
                                   send_latency_max=ms(4)))
@@ -1670,6 +1675,224 @@ def _prof_smoke_mode():
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
+def _lat_ab_mode():
+    """--mode lat_ab: SLO latency-plane overhead A/B on the fused
+    runner — the obs_ab/prof_ab protocol exactly (worst-case tiny step,
+    interleaved min-of-9 reps). Three builds, identical trajectories by
+    construction (the histogram writes consume no randomness):
+
+      off         latency_hist=0 — plane compiled out (baseline)
+      lat_masked  latency_hist=24 + completions compiled in, NO lanes
+                  recorded — the cost of carrying the histogram columns,
+                  the ev_root_t broadcast, and the masked saturating
+                  folds; the ship-with-it shape, bar ≤3% at B=512
+      lat_on      every lane records (the ceiling)
+
+    Writes BENCH_lat_ab_<platform>.json next to this file."""
+    _preflight_or_cpu("--lat-ab")
+    import jax
+    platform = jax.devices()[0].platform
+    B, steps, chunk, reps = 512, 2048, 256, 9
+    variants = (("off", 0, None), ("lat_masked", 24, []),
+                ("lat_on", 24, None))
+    out = {"metric": "lat_ab", "platform": platform, "batch": B,
+           "steps": steps, "chunk": chunk, "reps": reps,
+           "note": ("tiny 2-node workload = worst case for relative "
+                    "latency-plane overhead (fixed per-step folds + the "
+                    "ev_root_t emission broadcast vs tiny step); fused "
+                    "runner, lanes never halt, identical step counts "
+                    "per variant; reps interleaved round-robin, "
+                    "min-of-reps. lat_masked and lat_on execute "
+                    "identical compute (masked folds run either way) — "
+                    "spread between them is the noise floor. Bar: "
+                    "lat_masked <= 3% MODULO this host's cross-run "
+                    "envelope — as with causal_ab (DESIGN §12), "
+                    "repeated runs here have measured the SAME variant "
+                    "pair from +3.6% to -1.2%, so single-run numbers "
+                    "cannot resolve 3% on this CPU; the honest claim "
+                    "is overhead within that envelope, and the "
+                    "masked-vs-on spread (identical compute) bounds "
+                    "the floor"),
+           "variants": {}}
+    seeds = np.arange(B)
+    by_lat = {lat: _make_light_runtime(latency_hist=lat)
+              for lat in {lat for _, lat, _ in variants}}
+    rts, kws = {}, {}
+    for name, lat, lanes in variants:
+        rts[name] = by_lat[lat]
+        kws[name] = ({} if not lat or lanes is None
+                     else {"latency_lanes": lanes})
+    for rt in by_lat.values():
+        jax.block_until_ready(
+            rt.run_fused(rt.init_batch(seeds), steps, chunk).now)
+    best = {name: float("inf") for name, _, _ in variants}
+    for _ in range(reps):
+        for name, _, _ in variants:
+            state = rts[name].init_batch(seeds, **kws[name])
+            jax.block_until_ready(state.now)
+            t0 = time.perf_counter()
+            final = rts[name].run_fused(state, steps, chunk)
+            jax.block_until_ready(final.now)
+            best[name] = min(best[name], time.perf_counter() - t0)
+    eps = {name: B * steps / b for name, b in best.items()}
+    for name, _, _ in variants:
+        out["variants"][name] = round(eps[name], 1)
+        print(f"--lat-ab: {name} {eps[name]:,.0f} seed-events/s",
+              file=sys.stderr)
+    for name in ("lat_masked", "lat_on"):
+        out[f"overhead_{name}"] = round(eps["off"] / eps[name] - 1, 4)
+    # lat_masked and lat_on run the SAME executable on different lh_on
+    # values (identical compute — masked folds execute either way), so
+    # their pooled best is the honest program cost vs off — the
+    # causal_ab precedent (DESIGN §12) for hosts whose per-variant
+    # spread exceeds the bar being measured
+    pooled = max(eps["lat_masked"], eps["lat_on"])
+    out["overhead_lat_program"] = round(eps["off"] / pooled - 1, 4)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_lat_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _lat_smoke_mode():
+    """--lat-smoke: seconds-scale latency-plane self-test for CI (wired
+    into scripts/ci.sh fast):
+
+      1. on a direct-reply rpc_echo workload the digest's merged e2e
+         histogram must EQUAL a host walk of the flight-recorder ring
+         (tr_lat records every completion's latency; full-size ring =
+         complete history), and the ring latencies must match a
+         parent-walk reconstruction (now(completion) − now(root)) —
+         the root-inheritance rule, checked end to end;
+      2. the plane must be free of trajectory influence: fingerprints
+         equal across on/compiled-out, fused == chunked on every
+         latency column;
+      3. the SLO invariant roundtrip: a runtime with
+         slo_invariant(p99_le=) crashes with CRASH_SLO, twice
+         identically, and the (seed, knobs-free) repro replays;
+      4. the Perfetto export must carry a rolling e2e_p99 counter track
+         next to the instants.
+
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import json as _json
+    import tempfile
+    from madsim_tpu import (CRASH_SLO, NetConfig, Scenario, SimConfig,
+                            ms, sec, slo_invariant)
+    from madsim_tpu.core.state import TRACE_FIELDS
+    from madsim_tpu.core.types import EV_MSG
+    from madsim_tpu.models.rpc_echo import TAG_ECHO, make_echo_runtime
+    from madsim_tpu.net import rpc
+    from madsim_tpu.obs import export_profile_trace, ring_records
+    from madsim_tpu.parallel.stats import latency_counters
+    t0 = time.perf_counter()
+    seeds = np.arange(16, dtype=np.uint32)
+    rtag = rpc.reply_tag(TAG_ECHO)
+
+    def make(lat, invariant=None):
+        sc = Scenario()
+        sc.at(ms(300)).kill(0)
+        sc.at(ms(420)).restart(0)
+        cfg = SimConfig(
+            n_nodes=4, event_capacity=64, time_limit=sec(5),
+            latency_hist=24 if lat else 0, trace_cap=512 if lat else 0,
+            # reply delivery completes a call AND roots the next one
+            complete_kinds=(((EV_MSG, rtag),) if lat else ()),
+            root_kinds=(((EV_MSG, rtag),) if lat else ()),
+            net=NetConfig(send_latency_min=ms(1), send_latency_max=ms(8)))
+        rt = make_echo_runtime(n_nodes=4, target=8, scenario=sc, cfg=cfg)
+        if invariant is not None:
+            from madsim_tpu import Runtime
+            rt = Runtime(cfg, rt.programs, rt.state_spec,
+                         node_prog=rt.node_prog, scenario=sc,
+                         invariant=invariant, halt_when=rt._halt_when)
+        return rt
+
+    # 1+2: digest == ring == parent-walk reference; bit-identity
+    rt = make(lat=True)
+    rt_off = make(lat=False)
+    chunked, _ = rt.run(rt.init_batch(seeds), 2048, 256)
+    fused = rt.run_fused(rt.init_batch(seeds), 2048, 256)
+    off, _ = rt_off.run(rt_off.init_batch(seeds), 2048, 256)
+    assert (rt.fingerprints(chunked) == rt.fingerprints(fused)).all()
+    assert (rt.fingerprints(chunked) == rt_off.fingerprints(off)).all(), \
+        "latency plane perturbed the trajectory"
+    for f in TRACE_FIELDS:
+        assert (np.asarray(getattr(chunked, f))
+                == np.asarray(getattr(fused, f))).all(), f
+    c = latency_counters(chunked)
+    e2e = c["e2e_hist"].sum(0)
+    checked = 0
+    for b in range(len(seeds)):
+        recs = ring_records(chunked, b)
+        assert recs["dropped"] == 0, "ring must hold the whole history"
+        lat = np.asarray(recs["lat"])
+        done = lat >= 0
+        # parent-walk reference: completion.now − root.now, roots =
+        # external or root-kind dispatches (here: reply deliveries)
+        step_at = {int(s): i for i, s in enumerate(recs["step"])}
+        for i in np.nonzero(done)[0]:
+            j, root_now = int(i), None
+            while True:
+                p = int(recs["parent"][j])
+                if p < 0 or p not in step_at:
+                    root_now = int(recs["now"][j])   # external root
+                    break
+                jp = step_at[p]
+                if (int(recs["kind"][jp]) == EV_MSG
+                        and int(recs["tag"][jp]) == rtag):
+                    # parent was a completion→root re-mint
+                    root_now = int(recs["now"][jp])
+                    break
+                j = jp
+            want = int(recs["now"][i]) - root_now
+            assert int(lat[i]) == want, (b, int(i), int(lat[i]), want)
+            checked += 1
+        # ring → histogram: bucket the ring's latencies and compare
+        ref = np.zeros(len(e2e), np.int64)
+        for v in lat[done]:
+            bkt = 0 if v == 0 else min(int(v).bit_length(), len(e2e) - 1)
+            ref[bkt] += 1
+        per_lane = np.asarray(chunked.lh_e2e)[b].sum(0)
+        assert (per_lane == ref).all(), (b, per_lane, ref)
+    assert checked > 0 and int(e2e.sum()) > 0
+    # the digest's MERGE is exactly the sum of the per-lane histograms
+    assert (np.asarray(c["e2e_hist"])
+            == np.asarray(chunked.lh_e2e).sum(0)).all()
+
+    # 3: SLO invariant roundtrip — deterministic CRASH_SLO + replay
+    rt_slo = make(lat=True,
+                  invariant=slo_invariant(p99_le=ms(1), min_count=4))
+    s1 = rt_slo.run_fused(rt_slo.init_batch(seeds), 2048, 256)
+    s2 = rt_slo.run_fused(rt_slo.init_batch(seeds), 2048, 256)
+    codes = np.asarray(s1.crash_code)
+    assert (codes == CRASH_SLO).all(), codes
+    assert (np.asarray(s2.crash_code) == codes).all()
+    assert (rt_slo.fingerprints(s1) == rt_slo.fingerprints(s2)).all()
+    single, _ = rt_slo.run_single(int(seeds[3]), 2048, 256)
+    assert int(np.asarray(single.crash_code)[0]) == CRASH_SLO
+
+    # 4: Perfetto rolling-p99 track
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "lat.json")
+        n_inst = export_profile_trace(p, fused, lane=0)
+        with open(p) as f:
+            doc = _json.load(f)
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "C"}
+        assert any(nm.startswith("e2e_p99:") for nm in names), names
+        assert n_inst > 0
+    print(_json.dumps({
+        "metric": "lat_smoke", "platform": "cpu", "ok": True,
+        "lanes_checked": int(len(seeds)),
+        "completions": int(e2e.sum()),
+        "parent_walks_checked": int(checked),
+        "e2e_p99_us": c["e2e_p99"],
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
 def _causal_ab_mode():
     """--mode causal_ab: causal-lineage + prefix-sketch overhead A/B on
     the fused runner, same protocol as obs_ab (interleaved min-of-reps
@@ -2278,7 +2501,8 @@ def main():
                  "--compile-smoke", "--search-ab", "--search-smoke",
                  "--causal-ab", "--causal-smoke", "--campaign",
                  "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
-                 "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke"}
+                 "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke",
+                 "--lat-ab", "--lat-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
@@ -2291,6 +2515,12 @@ def main():
         return
     if "--prof-smoke" in sys.argv:
         _prof_smoke_mode()
+        return
+    if "--lat-ab" in sys.argv:
+        _lat_ab_mode()
+        return
+    if "--lat-smoke" in sys.argv:
+        _lat_smoke_mode()
         return
     if "--detsan-ab" in sys.argv:
         _detsan_ab_mode()

@@ -15,6 +15,7 @@ from .core.state import SimState
 from .core.types import (
     CRASH_DEADLOCK,
     CRASH_INVARIANT,
+    CRASH_SLO,
     CRASH_TIME_LIMIT,
     EV_MSG,
     EV_SUPER,
@@ -35,13 +36,16 @@ from .obs import (
     explain_crash,
     export_chrome_trace,
     export_profile_trace,
+    format_latency,
     format_profile,
+    latency_summary,
     profile_summary,
     ring_records,
 )
 from .harness.minimize import minimize_scenario
 from .harness.simtest import (DetSanFailure, SimFailure, detsan_check,
                               run_seeds, simtest)
+from .harness.slo import slo_invariant
 from .parallel.explore import explore
 from .parallel.stats import (divergence_profile, schedule_representatives,
                              summarize)
@@ -58,7 +62,7 @@ __all__ = [
     "Ctx", "Program", "Extension", "SimState", "SimConfig", "NetConfig",
     "Runtime", "Scenario", "simtest", "run_seeds", "SimFailure", "ms", "sec",
     "NODE_RANDOM", "EV_MSG", "EV_TIMER", "EV_SUPER", "CRASH_DEADLOCK",
-    "CRASH_TIME_LIMIT", "CRASH_INVARIANT",
+    "CRASH_TIME_LIMIT", "CRASH_INVARIANT", "CRASH_SLO", "slo_invariant",
     "explore", "minimize_scenario", "summarize", "schedule_representatives",
     "find_divergence",
     "fuzz", "fuzz_sharded", "Corpus", "KnobPlan", "pct_sweep",
@@ -66,6 +70,7 @@ __all__ = [
     "SweepObserver", "JsonlObserver", "ProgressObserver", "ring_records",
     "export_chrome_trace", "explain_crash", "divergence_profile",
     "profile_summary", "format_profile", "export_profile_trace",
+    "latency_summary", "format_latency",
     "CorpusStore", "run_campaign", "supervise_campaign", "campaign_report",
     "merged_buckets", "replay_bucket",
     "lint_runtime", "find_races", "confirm_race", "scan_races",

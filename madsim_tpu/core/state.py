@@ -28,9 +28,11 @@ from . import types as T
 
 # SimState fields owned by the flight recorder (cfg.trace_cap), the
 # causal-lineage layer (r10 — rides the same gate), the
-# prefix-coverage sketch (cfg.sketch_slots), and the sim-profiler
+# prefix-coverage sketch (cfg.sketch_slots), the sim-profiler
 # counter plane (cfg.profile, r15 — the pf_* columns + the tr_qlen
-# ring column). One schema constant so every consumer follows it
+# ring column), and the SLO latency plane (cfg.latency_hist, r16 —
+# the lh_* histograms, the ev_root_t root-birth-time column, and the
+# tr_lat ring column). One schema constant so every consumer follows it
 # automatically: excluded from fingerprints (utils/hashing —
 # observation only, never a replay domain), read by obs/rings.py (the
 # tr_* columns) and obs/profiler.py (the pf_* columns), compared
@@ -42,11 +44,13 @@ from . import types as T
 # (DESIGN §12).
 TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
                 "tr_kind", "tr_node", "tr_src", "tr_tag",
-                "tr_parent", "tr_lamport", "tr_qlen",
+                "tr_parent", "tr_lamport", "tr_qlen", "tr_lat",
                 "ev_prov", "lamport",
                 "cov_sketch", "sketch_every",
                 "pf_on", "pf_dispatch", "pf_busy", "pf_kill", "pf_restart",
-                "pf_qmax", "pf_drop", "pf_delay")
+                "pf_qmax", "pf_drop", "pf_delay",
+                "lh_on", "ev_root_t", "lh_sojourn", "lh_e2e",
+                "lh_slo_miss", "slo_target")
 
 # pf_dispatch's kind axis: one column per event kind (EV_FREE's column
 # exists so t_kind values index directly but is never written — only
@@ -188,6 +192,14 @@ class SimState:
                             # the profiler are (cfg.trace_cap > 0 and
                             # cfg.profile); zero-size otherwise, and
                             # ring readers skip zero-size columns
+    tr_lat: jax.Array       # int32[bucket] — the dispatch's END-TO-END
+                            # request latency when it was a completion
+                            # (cfg.complete_kinds), -1 otherwise — the
+                            # rolling-p99 counter-track source
+                            # (obs/profiler.py). Compiled in only when
+                            # BOTH the ring and the latency plane are
+                            # (cfg.trace_cap > 0 and cfg.latency_hist);
+                            # same skip contract as tr_qlen
 
     # --- prefix-coverage sketch (cfg.sketch_slots; obs/causal.py) ---------
     # Slot j holds the running sched_hash (lanes XOR-folded) after this
@@ -232,6 +244,33 @@ class SimState:
     pf_delay: jax.Array     # int32 — total latency ticks added to
                             # delivered sends (mean delay =
                             # pf_delay / delivered sends)
+
+    # --- SLO latency plane (cfg.latency_hist; obs/profiler.py) ------------
+    # Log2-bucketed request-latency histograms that live ON the device
+    # (DESIGN §17): bucket j counts latencies in [2^(j-1), 2^j) ticks
+    # (bucket 0 = zero). Written through the step's one-hot dispatch
+    # machinery like the pf_* counters; SATURATING; observation only
+    # (TRACE_FIELDS — no randomness, no non-latency state, excluded
+    # from fingerprints; zero-size when compiled out).
+    lh_on: jax.Array        # bool — lane gate (init_batch(latency_lanes=))
+    ev_root_t: jax.Array    # int32[C] — per pending row: virtual birth
+                            # time of the row's causal ROOT request;
+                            # -1 = external/unset (scenario rows, boots,
+                            # host injections) — minted as the dispatch
+                            # `now` at dispatch time and inherited by
+                            # every emission of that dispatch (the r10
+                            # provenance broadcast, carrying a time)
+    lh_sojourn: jax.Array   # int32[N, B] — queue-wait per dispatch
+                            # (now − dispatched row's deadline) at the
+                            # acting node, log2-bucketed
+    lh_e2e: jax.Array       # int32[N, B] — end-to-end latency
+                            # (now − root birth time) of dispatches of
+                            # cfg.complete_kinds, at the completion node
+    lh_slo_miss: jax.Array  # int32[N] — completions with e2e latency
+                            # > slo_target (when slo_target > 0)
+    slo_target: jax.Array   # int32 ticks — DYNAMIC per-lane SLO target
+                            # (cfg.slo_target seeds it; retune/fuzz
+                            # without recompile, like tlimit)
 
     # --- extension state (plugin framework analog, plugin.rs) -------------
     ext: Any                # dict: extension name -> its state subtree
@@ -302,6 +341,9 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         # the queue-depth ring column needs both gates (see field docs)
         tr_qlen=jnp.zeros((cfg.trace_cap_bucket if cfg.profile else 0,),
                           i32),
+        # the e2e-latency ring column likewise (ring AND latency plane)
+        tr_lat=jnp.full((cfg.trace_cap_bucket if cfg.latency_hist > 0
+                         else 0,), -1, i32),
         cov_sketch=jnp.zeros((cfg.sketch_slots,), jnp.uint32),
         sketch_every=jnp.asarray(cfg.sketch_every, i32),
         # profiler default: every lane counts (when the plane is
@@ -316,6 +358,17 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         pf_qmax=jnp.asarray(0, i32),
         pf_drop=jnp.asarray(0, i32),
         pf_delay=jnp.asarray(0, i32),
+        # latency-plane default: every lane records (when compiled in);
+        # init_batch(latency_lanes=...) narrows. Same zero-size shape
+        # discipline as the pf_* columns; ev_root_t starts all-external
+        lh_on=jnp.asarray(cfg.latency_hist > 0),
+        ev_root_t=jnp.full((C if cfg.latency_hist > 0 else 0,), -1, i32),
+        lh_sojourn=jnp.zeros((N if cfg.latency_hist > 0 else 0,
+                              cfg.latency_hist), i32),
+        lh_e2e=jnp.zeros((N if cfg.latency_hist > 0 else 0,
+                          cfg.latency_hist), i32),
+        lh_slo_miss=jnp.zeros((N if cfg.latency_hist > 0 else 0,), i32),
+        slo_target=jnp.asarray(cfg.slo_target, i32),
         ext=ext_state if ext_state is not None else {},
     )
 

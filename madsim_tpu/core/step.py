@@ -222,6 +222,36 @@ def make_step(
         now = jnp.where(valid, jnp.maximum(s.now, dmin), s.now)
         if cfg.profile:
             now_delta = now - s.now          # >= 0; 0 when not valid
+
+        # ---- SLO latency plane inputs (cfg.latency_hist; DESIGN §17) -----
+        # Read BEFORE the pop/emission phase: the popped slot may be
+        # reclaimed by this very dispatch's emissions, which overwrite
+        # ev_root_t. Root rule: a row whose root is unset (-1 — scenario
+        # rows, node boots, host injections: external causes) MINTS its
+        # root at dispatch (`now`); everything it emits inherits it.
+        # Sojourn = now − the dispatched row's deadline (all
+        # earliest-deadline ties share dmin) — the queue-wait this row
+        # paid to contention/parking. Pure selects, no randomness.
+        if cfg.latency_hist > 0:
+            root_raw = sel.take1(s.ev_root_t, idx)
+            inherit = valid & (root_raw >= 0)
+            # the root this dispatch MEASURES against (completion fold):
+            # always the inherited one, so a (complete AND root) kind —
+            # e.g. a reply delivery that also starts the next sequential
+            # call — measures the finished request before restarting
+            root_measured = jnp.where(inherit, root_raw, now)
+            if cfg.root_kinds:
+                # model-declared request STARTS re-mint the root even on
+                # an inherited chain (the closed-loop client's new-
+                # request timer; see types.py root_kinds)
+                is_root_kind = functools.reduce(
+                    jnp.logical_or,
+                    [(ev_kind == k) & (ev_tag == t)
+                     for k, t in cfg.root_kinds])
+                inherit = inherit & ~is_root_kind
+            # the root this dispatch's EMISSIONS inherit (post-mint)
+            ev_root = jnp.where(inherit, root_raw, now)
+            lat_sojourn = jnp.maximum(jnp.where(valid, now - dmin, 0), 0)
         # strict >: the scenario's HALT op sits at exactly time_limit, and
         # same-deadline ties may dispatch before it without being late
         time_over = now > s.tlimit
@@ -475,6 +505,18 @@ def make_step(
                 else:
                     s = s.replace(ev_prov=jnp.where(
                         written[:, None], prov_new[None, :], s.ev_prov))
+            if cfg.latency_hist > 0:
+                # root-birth-time inheritance: every row this dispatch
+                # emits carries the dispatch's own root — the same
+                # one-broadcast-per-dispatch shape as ev_prov above,
+                # riding the identical slots_eff / written machinery
+                if em_scatter:
+                    s = s.replace(ev_root_t=s.ev_root_t.at[slots_eff].set(
+                        jnp.broadcast_to(ev_root, (E,)),
+                        mode="drop", unique_indices=True))
+                else:
+                    s = s.replace(ev_root_t=jnp.where(
+                        written, ev_root, s.ev_root_t))
 
         # oops/steps are correctness-bearing and always tracked; the stat
         # counters honor cfg.collect_stats (Stat is optional in the
@@ -536,6 +578,58 @@ def make_step(
                 pf_delay=_sat_add(s.pf_delay,
                                   jnp.where(rec_p, delay_acc, 0)),
             )
+
+        # ---- SLO latency plane (cfg.latency_hist; DESIGN §17) ------------
+        # Fold this dispatch's queue-wait — and, on completion kinds, its
+        # end-to-end request latency — into the per-node log2 histograms.
+        # Bucketing is EXACT integer arithmetic: bucket(d) counts the
+        # thresholds 2^j <= d, so d in [2^(j-1), 2^j) lands in bucket j
+        # and d == 0 in bucket 0 (a float log2 would misbucket near
+        # power-of-two boundaries). One [N]x[B] one-hot saturating write
+        # per histogram; no randomness, no non-latency state — the same
+        # transparency contract as the pf_* counters, and the fold runs
+        # BEFORE the end-condition checks so an `invariant=` (e.g.
+        # harness.slo_invariant) sees this dispatch's completion.
+        lat_e2e = None
+        if cfg.latency_hist > 0:
+            LB = cfg.latency_hist
+            rec_l = valid & s.lh_on
+            thr = jnp.asarray([1 << j for j in range(LB - 1)], jnp.int32)
+
+            def bucket_oh(d):     # [LB] one-hot of d's log2 bucket
+                b = (d >= thr).sum(dtype=jnp.int32)
+                return jnp.arange(LB, dtype=jnp.int32) == b
+
+            # sojourn at the ACTING node (supervisor ops: the resolved
+            # target — same attribution rule as pf_busy)
+            act_l = jnp.where(is_super, reset_target, ev_node)
+            oh_act = sel.row_onehot(cfg.n_nodes, act_l)       # [N]
+            s = s.replace(lh_sojourn=_sat_add(
+                s.lh_sojourn,
+                (oh_act[:, None] & bucket_oh(lat_sojourn)[None, :]
+                 & rec_l).astype(jnp.int32)))
+            if cfg.complete_kinds:
+                is_complete = valid & functools.reduce(
+                    jnp.logical_or,
+                    [(ev_kind == k) & (ev_tag == t)
+                     for k, t in cfg.complete_kinds])
+                lat_e2e = jnp.maximum(now - root_measured, 0)
+                oh_cpl = sel.row_onehot(cfg.n_nodes, ev_node)  # [N]
+                done_l = is_complete & s.lh_on
+                miss = (done_l & (s.slo_target > 0)
+                        & (lat_e2e > s.slo_target))
+                s = s.replace(
+                    lh_e2e=_sat_add(
+                        s.lh_e2e,
+                        (oh_cpl[:, None] & bucket_oh(lat_e2e)[None, :]
+                         & done_l).astype(jnp.int32)),
+                    lh_slo_miss=_sat_add(
+                        s.lh_slo_miss,
+                        (oh_cpl & miss).astype(jnp.int32)))
+                # the ring's per-dispatch latency value (tr_lat):
+                # completions record e2e, everything else -1
+                lat_e2e = jnp.where(is_complete, lat_e2e,
+                                    jnp.asarray(-1, jnp.int32))
 
         # ---- prefix-coverage sketch (cfg.sketch_slots; DESIGN §12) -------
         # Fold the running sched_hash into slot j = steps/every - 1 at
@@ -623,6 +717,13 @@ def make_step(
             # compiled in (its counter-track source; zero-size otherwise)
             extra_cols = (dict(tr_qlen=ringput(s.tr_qlen, occ_disp))
                           if cfg.profile else {})
+            if cfg.latency_hist > 0:
+                # e2e-latency ring column (rolling-p99 track source):
+                # completions record their latency, everything else -1
+                extra_cols["tr_lat"] = ringput(
+                    s.tr_lat,
+                    lat_e2e if lat_e2e is not None
+                    else jnp.asarray(-1, jnp.int32))
             s = s.replace(
                 **extra_cols,
                 tr_now=ringput(s.tr_now, record["now"]),

@@ -73,6 +73,8 @@ CRASH_DEADLOCK = -1        # no eligible event and no HALT reached
                            #  task.rs:110-124)
 CRASH_TIME_LIMIT = -2      # virtual-time limit exceeded (set_time_limit)
 CRASH_INVARIANT = -3       # global invariant check failed (generic)
+CRASH_SLO = -4             # tail-latency SLO invariant failed
+                           # (harness.slo_invariant over the latency plane)
 
 # Oops bits (state.oops) — resource-exhaustion flags instead of UB. The
 # reference grows Vecs unboundedly; static shapes require capacities.
@@ -273,6 +275,64 @@ class SimConfig:
     # profile=True and flip lanes on per sweep (the masked-off overhead
     # bar is ≤3% on the tiny-step worst case, bench.py --mode prof_ab).
     profile: bool = False
+    # SLO latency plane (obs/profiler.py, DESIGN §17): number of log2
+    # buckets in the on-device request-latency histograms. 0 (default)
+    # compiles the plane out entirely — zero-size columns, no latency
+    # code in the step. > 0 adds, per lane:
+    #   lh_sojourn [N, B]  queue-wait per dispatch (now − the dispatched
+    #                      row's deadline), bucketed by floor-log2 ticks
+    #                      at the acting node;
+    #   lh_e2e     [N, B]  END-TO-END request latency: every pending row
+    #                      carries the birth time of its causal ROOT
+    #                      (ev_root_t — external/scenario rows mint
+    #                      root = dispatch `now`, emissions inherit the
+    #                      dispatching event's root through the same
+    #                      broadcast-select as the r10 provenance pair),
+    #                      and a dispatch of a model-declared COMPLETION
+    #                      kind (complete_kinds below) folds now − root
+    #                      into the completion node's histogram;
+    #   lh_slo_miss [N]    completions whose e2e latency exceeded the
+    #                      DYNAMIC per-lane SimState.slo_target knob
+    #                      (slo_target below; 0 disables — retune or
+    #                      fuzz the target without recompile).
+    # Bucket j holds latencies in [2^(j-1), 2^j) ticks (bucket 0 = zero
+    # ticks); 32 buckets cover the whole int32 tick range. Counts
+    # SATURATE at int32 max (the §16 discipline). Like trace_cap, an
+    # observation lever, not a replay domain: the writes consume no
+    # randomness and touch no non-latency state, trajectories are
+    # BIT-IDENTICAL across settings, and the lh_*/ev_root_t columns
+    # ride TRACE_FIELDS out of fingerprints. Per-lane masking rides
+    # `init_batch(latency_lanes=...)`. (Installing harness.slo_invariant
+    # deliberately pierces this: an SLO miss becomes a crash code —
+    # that runtime's replay domain includes the plane, see DESIGN §17.)
+    latency_hist: int = 0
+    # which dispatches COMPLETE a request, as ((event_kind, tag), ...)
+    # pairs — e.g. ((EV_MSG, CRSP),) for "client saw its reply".
+    # STRUCTURAL: the completion mask compiles into the step. Empty
+    # (default) = no end-to-end tracking; the sojourn histogram still
+    # fills (it needs no request notion).
+    complete_kinds: tuple = ()
+    # which dispatches START a request: ((event_kind, tag), ...) pairs
+    # that MINT a fresh root (root = dispatch now) instead of
+    # inheriting the chain's. External dispatches (scenario rows, node
+    # boots, host injections) always mint — an OPEN-loop client whose
+    # arrivals are scenario rows needs no root_kinds at all. Declare a
+    # CLOSED-loop client's new-request timer here (e.g.
+    # ((EV_TIMER, T_NEW),)), or its e2e would measure time since the
+    # chain's external root (the node's boot), not per-request latency.
+    # A pair may appear in BOTH complete_kinds and root_kinds (a reply
+    # delivery that starts the next sequential call): the completion
+    # measures against the INHERITED root, then the mint restarts the
+    # chain. CAVEAT (DESIGN §17): roots ride the single-parent causal
+    # chain, so pick completion events whose chain actually descends
+    # from the request — a reply emitted while applying a REPLICATION
+    # ack (raft-backed servers) descends from the ack chain, not the
+    # request; measure such systems at a chain-correct point (e.g. the
+    # request's arrival at the group) or use a direct-reply server.
+    root_kinds: tuple = ()
+    # initial SimState.slo_target in ticks (DYNAMIC knob — the per-lane
+    # state field is what the miss counter compares against; 0 disables)
+    slo_target: int = 0
     # emission-write lowering: how staged emissions land in the event
     # table. "onehot" = [E, C] one-hot masked-sum (VPU-friendly — the TPU
     # default); "scatter" = one XLA scatter per column at distinct slot
@@ -291,6 +351,29 @@ class SimConfig:
         assert self.trace_cap >= 0
         assert self.sketch_slots >= 0
         assert isinstance(self.profile, bool)
+        assert 0 <= self.latency_hist <= 32, \
+            "latency_hist is a log2 BUCKET COUNT; 32 covers int32 ticks"
+        assert self.slo_target >= 0
+        # normalize to a tuple of (kind, tag) int pairs (frozen dataclass:
+        # go through object.__setattr__) so the signature/hash are stable
+        # across list-vs-tuple spellings
+        for field in ("complete_kinds", "root_kinds"):
+            object.__setattr__(
+                self, field,
+                tuple((int(p[0]), int(p[1])) for p in getattr(self, field)))
+            for pair in getattr(self, field):
+                # messages/timers only: a supervisor op is an external
+                # CAUSE (it mints a root by being external), never a
+                # request boundary — and its scheduled row may carry a
+                # NODE_RANDOM placeholder that would misattribute the
+                # completion's node
+                assert pair[0] in (EV_MSG, EV_TIMER), \
+                    f"{field} entries are (EV_MSG|EV_TIMER, tag) " \
+                    f"pairs: {pair}"
+        if self.complete_kinds or self.root_kinds or self.slo_target:
+            assert self.latency_hist > 0, \
+                "complete_kinds/root_kinds/slo_target need the latency " \
+                "plane compiled in (latency_hist > 0)"
         assert self.sketch_every >= 1
         assert self.table_dtype in ("int32", "int16")
         assert self.emission_write in ("auto", "onehot", "scatter")
@@ -315,11 +398,12 @@ class SimConfig:
         ride as operands. `emission_write` stays raw here — 'auto'
         resolves per backend at trace time, and the cache keys the
         backend separately."""
-        return ("simconfig-v3", self.n_nodes, self.event_capacity,
+        return ("simconfig-v4", self.n_nodes, self.event_capacity,
                 self.payload_words, self.table_dtype, self.emission_write,
                 bool(self.collect_stats), self.trace_cap_bucket,
                 self.sketch_slots, self.net.op_jitter_max > 0,
-                bool(self.profile))
+                bool(self.profile),
+                self.latency_hist, self.complete_kinds, self.root_kinds)
 
     def hash(self) -> str:
         """Stable 8-hex-digit config hash, printed on test failure so a repro

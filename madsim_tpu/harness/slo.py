@@ -1,0 +1,110 @@
+"""SLO invariants: tail latency as a first-class crash code.
+
+The latency plane (cfg.latency_hist, DESIGN §17) measures; this module
+ENFORCES: `slo_invariant(p99_le=...)` builds a traced callable over the
+on-device histogram columns usable as `Runtime(invariant=)`, so an SLO
+miss is a crash code the whole search/triage stack inherits for free —
+crashed lanes carry `CRASH_SLO`, the fuzzer harvests (seed, knobs)
+repros, `harness.minimize` ddmin-shrinks the fault script that caused
+the tail, and `service.CrashBuckets` dedups SLO regressions by causal
+fingerprint next to safety bugs.
+
+The deliberate contract pierce: installing an SLO invariant makes the
+latency plane OBSERVABLE — crash_code now depends on lh_e2e, so for
+THAT runtime the plane is part of the replay domain (exactly like
+`halt_when` reading any state). The plane stays transparent for every
+runtime that doesn't install one; tests hold both directions. Keep
+every lane's latency recording ON (the init_batch default): a
+`latency_lanes`-masked lane never folds, so its SLO can never fire.
+
+Determinism: the p99 estimate is the bucket-CDF lower bound
+(parallel/stats quantile rule — exact integer bucketing, exact integer
+CDF), so the check is a pure function of the lane's dispatch history
+and fires on the SAME dispatch in every replay.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import types as T
+from ..parallel.stats import bucket_lower_edge
+
+# quantile -> (numerator, denominator) so the threshold stays exact
+# integer arithmetic: the q-th sample index is ceil(total * num / den)
+_Q_RATIONAL = {"p50": (1, 2), "p90": (9, 10), "p99": (99, 100),
+               "p999": (999, 1000)}
+
+
+def _hist_quantile_edge(hist2d, num: int, den: int):
+    """Lower bucket edge (ticks) of the q = num/den quantile of a
+    per-lane [N, B] int32 histogram, nodes folded — all-integer, so the
+    traced check is bit-deterministic. 0 when the histogram is empty."""
+    counts = hist2d.sum(0).astype(jnp.int32)          # [B]
+    total = counts.sum()
+    cdf = jnp.cumsum(counts)
+    # ceil(total*num/den) without floats; >= 1 so an empty cdf row
+    # can't match bucket 0 spuriously (guarded by total > 0 anyway).
+    # int32-exact while total < 2^31/den (~2.1M samples per LANE at
+    # p999) — orders of magnitude above any per-trajectory completion
+    # count here (total counts one lane's own dispatches)
+    need = jnp.maximum((total * num + den - 1) // den, 1)
+    b = jnp.argmax(cdf >= need).astype(jnp.int32)
+    return jnp.where(total > 0, bucket_lower_edge(b), 0), total
+
+
+def slo_invariant(p99_le: int | None = None, *, q: str = "p99",
+                  target: int | None = None, sojourn: bool = False,
+                  min_count: int = 1, code: int = T.CRASH_SLO):
+    """Build a `Runtime(invariant=)` callable that crashes a lane when
+    its request-latency quantile exceeds a target.
+
+    Args:
+      p99_le: the common case — crash when the lane's end-to-end p99
+        estimate exceeds this many ticks. Sugar for q="p99",
+        target=p99_le.
+      q / target: any of p50/p90/p99/p999 against `target` ticks.
+      sojourn: check the queue-wait histogram (lh_sojourn) instead of
+        end-to-end (lh_e2e) — queue-pressure SLOs without a request
+        notion (no complete_kinds needed).
+      min_count: fire only once the lane folded at least this many
+        samples (an SLO over 1 request is noise; raise it to let the
+        workload warm up).
+      code: the crash code reported (default CRASH_SLO).
+
+    The estimate is the bucket-CDF LOWER bound (quantile rule,
+    parallel/stats): it can only under-read, so a firing invariant
+    means the true bucketed quantile genuinely exceeds the target —
+    no false positives from bucket granularity. Conservative direction:
+    a target inside a bucket's span may fire one bucket late, never
+    early.
+
+    Requires cfg.latency_hist > 0 (raises at trace time with a clear
+    message otherwise) and, for the e2e form, cfg.complete_kinds
+    declared — an empty histogram never fires (min_count).
+    """
+    if p99_le is not None:
+        q, target = "p99", p99_le
+    if target is None:
+        raise ValueError("slo_invariant needs p99_le= or (q=, target=)")
+    if q not in _Q_RATIONAL:
+        raise ValueError(f"q must be one of {sorted(_Q_RATIONAL)}: {q!r}")
+    num, den = _Q_RATIONAL[q]
+    target = int(target)
+    min_count = int(min_count)
+    field = "lh_sojourn" if sojourn else "lh_e2e"
+
+    def check(state):
+        hist = getattr(state, field)
+        if hist.shape[-2] == 0 or hist.shape[-1] == 0:
+            raise ValueError(
+                "slo_invariant needs the latency plane compiled in — "
+                "set SimConfig(latency_hist=...) > 0"
+                + ("" if sojourn else
+                   " and declare complete_kinds (no completions = the "
+                   "e2e histogram never fills)"))
+        edge, total = _hist_quantile_edge(hist, num, den)
+        bad = (total >= min_count) & (edge > target)
+        return bad, jnp.asarray(code, jnp.int32)
+
+    return check

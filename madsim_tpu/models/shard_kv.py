@@ -571,8 +571,11 @@ def all_clients_done(clients_base: int, n_ops: int):
 
 def make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2, n_keys=8,
                        n_shards=4, n_ops=6, max_cfg=4, log_capacity=64,
-                       scenario=None, cfg=None, **kw):
-    """Assemble the full sharded-KV cluster runtime."""
+                       scenario=None, cfg=None, extra_invariant=None, **kw):
+    """Assemble the full sharded-KV cluster runtime. `extra_invariant`
+    composes an additional (bad, code) check alongside the per-group
+    Raft invariants — e.g. `harness.slo_invariant` so a p99 regression
+    crashes next to the safety checks (examples/open_loop_kv.py)."""
     from ..core.types import NetConfig, SimConfig, sec
     from ..runtime.runtime import Runtime
     n = rc + n_groups * rg + n_clients
@@ -601,9 +604,10 @@ def make_shard_runtime(n_groups=2, rg=3, rc=3, n_clients=2, n_keys=8,
         base = rc + g * rg
         masks.append((np.arange(n) >= base) & (np.arange(n) < base + rg))
     inv = compose_invariants(
-        *[R.raft_invariant(n, log_capacity, FIELDS, m,
-                           window_slides=R.window_slides_for(kw))
-          for m in masks])
+        *([R.raft_invariant(n, log_capacity, FIELDS, m,
+                            window_slides=R.window_slides_for(kw))
+           for m in masks]
+          + ([extra_invariant] if extra_invariant is not None else [])))
     clients_base = rc + n_groups * rg
     return Runtime(cfg, progs,
                    shard_state_spec(n, log_capacity, n_groups=n_groups,

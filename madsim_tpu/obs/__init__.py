@@ -29,15 +29,22 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   (SimState pf_* columns — per-node dispatch/busy,
                   queue pressure, drop/delay, kill/boot counts), fed by
                   the on-device `parallel.stats.profile_digest`
-                  reduction. O(counters) per sweep crosses the host
-                  boundary, at syncs the runners already pay.
+                  reduction, and — r16 — the HOW-LONG layer over the
+                  `cfg.latency_hist` plane: `latency_summary` /
+                  `format_latency` render p50/p99/p999 + SLO misses
+                  from `parallel.stats.latency_digest`, plus a rolling
+                  per-node e2e-p99 Perfetto track off the `tr_lat`
+                  ring column. O(counters + buckets) per sweep crosses
+                  the host boundary, at syncs the runners already pay.
 """
 
 from .causal import (causal_fingerprint, code_fingerprint, explain_crash,
                      fingerprints_match, happens_before, sketch_divergence)
 from .metrics import JsonlObserver, SweepObserver, TeeObserver
 from .profiler import (counter_track_events, export_profile_trace,
-                       format_profile, profile_summary)
+                       format_latency, format_profile,
+                       latency_histogram_rows, latency_summary,
+                       profile_summary)
 from .progress import ProgressObserver
 from .rings import ring_records, sampled_lanes
 from .trace import export_chrome_trace, to_chrome_events
@@ -50,4 +57,5 @@ __all__ = [
     "causal_fingerprint", "code_fingerprint", "fingerprints_match",
     "profile_summary", "format_profile", "counter_track_events",
     "export_profile_trace",
+    "latency_summary", "format_latency", "latency_histogram_rows",
 ]

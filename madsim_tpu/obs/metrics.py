@@ -23,7 +23,13 @@ blocked on anyway. Record kinds (each a flat JSON-able dict carrying
            round's median first-divergence slot vs the consensus prefix)
            when the build compiles the prefix sketch in
            (cfg.sketch_slots > 0) — depth telemetry riding the sketch
-           transfer the corpus already pays for. Mesh-sharded campaigns
+           transfer the corpus already pays for. Builds with the SLO
+           latency plane compiled in (cfg.latency_hist > 0, r16) add
+           `lat_p99` (the round batch's merged end-to-end p99 estimate
+           in ticks, bucket-CDF lower bound), `lat_p50`, and `slo_miss`
+           (completions past the dynamic slo_target this round) — and
+           run()'s `done` record carries the same three for plain
+           sweeps. Mesh-sharded campaigns
            (search/shard.py) add shards (mesh width) and per_shard —
            one row per device shard: {shard, worker_id, corpus_size,
            coverage, new, crashes, seeds_run} — so renderers can show

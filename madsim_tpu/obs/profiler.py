@@ -1,11 +1,16 @@
-"""Sim-profiler reports + Perfetto counter tracks over the counter plane.
+"""Sim-profiler reports + Perfetto counter tracks over the counter AND
+latency planes.
 
-The WHERE layer of the observability stack (DESIGN §16): the r7 ring
-answers *what happened*, the r10 lineage answers *why* — this module
-answers *where the simulated cluster spends its effort*, from the
-`cfg.profile` counter columns that live IN SimState (core/state.py
-`pf_*`) and therefore survive the fused while_loop with zero new host
-round-trips. Two consumers:
+The WHERE/HOW-LONG layer of the observability stack (DESIGN §16/§17):
+the r7 ring answers *what happened*, the r10 lineage answers *why* —
+this module answers *where the simulated cluster spends its effort*
+(the `cfg.profile` pf_* counters) and *how long requests take* (the
+`cfg.latency_hist` lh_* histograms, r16: `latency_summary` /
+`format_latency` render p50/p99/p999 + SLO misses off the on-device
+`parallel.stats.latency_digest` reduction, and `counter_track_events`
+adds a rolling per-node e2e p99 track from the `tr_lat` ring column
+next to busy%/queue depth). All columns live IN SimState and survive
+the fused while_loop with zero new host round-trips. Two consumers:
 
   * `profile_summary` / `format_profile` — the report object: batch-sum
     counters off the on-device `parallel.stats.profile_digest` reduction
@@ -30,7 +35,8 @@ import json
 
 import numpy as np
 
-from ..parallel.stats import profile_counters
+from ..parallel.stats import (latency_bucket_edges, latency_counters,
+                              profile_counters)
 from .rings import ring_records
 from .trace import _doc, to_chrome_events
 
@@ -111,6 +117,83 @@ def format_profile(summary: dict, node_names=None) -> str:
     return "\n".join(lines)
 
 
+def latency_summary(state) -> dict | None:
+    """The latency report for a (finished or running) batched state:
+    merged histogram quantiles plus derived rates, off the on-device
+    `parallel.stats.latency_digest` reduction (O(buckets) transfer).
+    None when the plane is compiled out (cfg.latency_hist == 0) or the
+    state is unbatched.
+
+    Quantile estimates are bucket-CDF LOWER bounds in ticks (µs) —
+    deterministic, conservative (DESIGN §17). `slo_miss_rate` is
+    misses per completion (0 when slo_target was 0 or nothing
+    completed)."""
+    c = latency_counters(state)
+    if c is None:
+        return None
+    e2e = np.asarray(c["e2e_hist"], np.int64)           # [N, B]
+    soj = np.asarray(c["sojourn_hist"], np.int64)
+    completions = int(e2e.sum())
+    return dict(
+        lanes=c["lanes"],
+        buckets=int(e2e.shape[1]),
+        completions=completions,
+        completions_by_node=e2e.sum(-1).tolist(),
+        e2e_p50=c["e2e_p50"], e2e_p90=c["e2e_p90"],
+        e2e_p99=c["e2e_p99"], e2e_p999=c["e2e_p999"],
+        e2e_p99_by_node=c["e2e_p99_by_node"],
+        sojourn_p50=c["sojourn_p50"], sojourn_p90=c["sojourn_p90"],
+        sojourn_p99=c["sojourn_p99"], sojourn_p999=c["sojourn_p999"],
+        sojourn_events=int(soj.sum()),
+        slo_miss=c["slo_miss"],
+        slo_miss_by_node=c["slo_miss_by_node"],
+        slo_miss_rate=round(c["slo_miss"] / max(completions, 1), 4),
+    )
+
+
+def format_latency(summary: dict, node_names=None) -> str:
+    """Render a `latency_summary` dict as a fixed-width text table —
+    the operator-facing SLO report."""
+    if summary is None:
+        return "latency plane compiled out (SimConfig.latency_hist=0)"
+    N = len(summary["completions_by_node"])
+    name = (node_names if node_names is not None
+            else [f"node{n}" for n in range(N)])
+    lines = [
+        f"recorded lanes: {summary['lanes']}  "
+        f"completions: {summary['completions']}  "
+        f"slo_miss: {summary['slo_miss']} "
+        f"({summary['slo_miss_rate']:.2%})",
+        f"e2e p50/p90/p99/p999: {summary['e2e_p50']}/"
+        f"{summary['e2e_p90']}/{summary['e2e_p99']}/"
+        f"{summary['e2e_p999']}us  "
+        f"sojourn p50/p99: {summary['sojourn_p50']}/"
+        f"{summary['sojourn_p99']}us",
+        f"{'node':<12} {'completions':>12} {'e2e_p99':>9} {'slo_miss':>9}",
+    ]
+    for n in range(N):
+        lines.append(
+            f"{name[n]:<12} {summary['completions_by_node'][n]:>12} "
+            f"{summary['e2e_p99_by_node'][n]:>9} "
+            f"{summary['slo_miss_by_node'][n]:>9}")
+    return "\n".join(lines)
+
+
+def latency_histogram_rows(state) -> list[dict] | None:
+    """The merged histograms as JSON-able rows (one per bucket with any
+    count): {bucket, lo_us, e2e, sojourn} — dashboard/ingest format.
+    None when the plane is compiled out."""
+    c = latency_counters(state)
+    if c is None:
+        return None
+    e2e = np.asarray(c["e2e_hist"], np.int64).sum(0)
+    soj = np.asarray(c["sojourn_hist"], np.int64).sum(0)
+    edges = latency_bucket_edges(len(e2e))
+    return [dict(bucket=int(b), lo_us=int(edges[b]),
+                 e2e=int(e2e[b]), sojourn=int(soj[b]))
+            for b in range(len(e2e)) if e2e[b] or soj[b]]
+
+
 def _counter(name: str, ts: int, value, series: str = "value",
              pid: int = 0) -> dict:
     return dict(name=name, ph="C", ts=int(ts), pid=pid,
@@ -118,7 +201,8 @@ def _counter(name: str, ts: int, value, series: str = "value",
 
 
 def counter_track_events(state, lane: int = 0, node_names=None,
-                         consensus=None, recs=None) -> list[dict]:
+                         consensus=None, recs=None,
+                         p99_window: int = 64) -> list[dict]:
     """Perfetto counter-track events for one lane, from the ring window
     (cfg.trace_cap > 0; the lane must be sampled):
 
@@ -134,6 +218,11 @@ def counter_track_events(state, lane: int = 0, node_names=None,
                      checkpoint nearest each ring record (cfg.sketch_slots
                      builds only; `consensus` overrides the batch modal,
                      e.g. with a campaign's cross-round consensus)
+      e2e_p99:<n>    ROLLING p99 of the last `p99_window` completions at
+                     node n, from the `tr_lat` ring column (present only
+                     on cfg.latency_hist builds with complete_kinds) —
+                     the tail curve over virtual time, next to the
+                     pressure curves it correlates with
 
     Timestamps ride the same virtual-time axis as the r7 instants, so
     the tracks align with the event timeline in one document. Pass an
@@ -166,6 +255,27 @@ def counter_track_events(state, lane: int = 0, node_names=None,
                 out.append(_counter(f"busy_pct:{label[nd]}", now_i,
                                     round(100.0 * busy[nd] / span, 2),
                                     "busy_pct"))
+    # rolling per-node e2e p99 over the ring window's completions
+    lat = recs.get("lat")
+    if lat is not None and n:
+        label = {}
+        window: dict[int, list] = {}
+        for i in range(n):
+            li = int(lat[i])
+            if li < 0:          # not a completion dispatch
+                continue
+            nd = int(recs["node"][i])
+            if nd not in window:
+                window[nd] = []
+                label[nd] = (node_names[nd] if node_names is not None
+                             else f"node{nd}")
+            w = window[nd]
+            w.append(li)
+            if len(w) > p99_window:
+                del w[0]
+            out.append(_counter(
+                f"e2e_p99:{label[nd]}", recs["now"][i],
+                float(np.percentile(np.asarray(w), 99)), "p99_us"))
     sk = np.asarray(getattr(state, "cov_sketch", np.zeros((0, 0))))
     if n and sk.ndim == 2 and sk.shape[1] > 0:
         from ..parallel.stats import first_divergence_slots

@@ -20,6 +20,21 @@ def _rate(x: float) -> str:
     return f"{x:.0f}"
 
 
+def _lat(rec) -> str:
+    """Render the round/sweep's tail-latency column ("  p99 1.2ms"
+    plus "  slo_miss N" when misses were counted) — empty when the
+    record predates the latency plane (r16) or the build compiles it
+    out."""
+    if rec.get("lat_p99") is None:
+        return ""
+    p99 = rec["lat_p99"]
+    txt = (f"  p99 {p99 / 1000:.1f}ms" if p99 >= 1000
+           else f"  p99 {p99}us")
+    if rec.get("slo_miss"):
+        txt += f"  slo_miss {rec['slo_miss']}"
+    return txt
+
+
 def _top_yield(op_yield) -> str:
     """Render the most productive mutation operator of a round/shard
     ("  yield time_nudge:3") — empty when nothing was admitted or the
@@ -100,7 +115,7 @@ class ProgressObserver:
         self._show(
             f"round {rec['round']:>3}  +{rec['new_schedules']} new "
             f"schedules ({rec['distinct_total']} distinct)  "
-            f"crashes {rec['crashes']}{corpus}{shards}"
+            f"crashes {rec['crashes']}{corpus}{shards}{_lat(rec)}"
             f"{_top_yield(rec.get('op_yield'))}", force=True)
         if rec.get("shards", 1) > 1 and rec.get("per_shard"):
             # one row per shard — a mesh campaign's telemetry must not
@@ -128,6 +143,8 @@ class ProgressObserver:
             parts.append(f"halted {rec['lanes_halted']}/{rec['batch']}")
         if "distinct_total" in rec:
             parts.append(f"{rec['distinct_total']} distinct schedules")
+        if rec.get("lat_p99") is not None:
+            parts.append(_lat(rec).strip())
         if "wall_s" in rec:
             parts.append(f"{rec['wall_s']:.2f}s")
         self._show("  ".join(parts), force=True)

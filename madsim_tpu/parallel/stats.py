@@ -164,6 +164,37 @@ def divergence_profile(state) -> dict | None:
     return out
 
 
+def _masked_half_sums(x, wm):
+    """Masked batch sum of an int32 counter array, WIDE: int64 is
+    unavailable without x64, and a plain int32 sum of per-lane counters
+    wraps at realistic scale (512 lanes × ~1e7 busy ticks > 2^31) —
+    exactly the wrapped-negative reading the saturating per-lane
+    counters exist to prevent. Each counter is split into 16-bit halves
+    and the halves summed separately; the host recombines hi·2^16 + lo
+    into exact Python ints. Half-sums stay in-range for B ≤ 32767
+    lanes — far above any single-device batch. `wm` is the 0/1 lane
+    mask broadcast to x's shape. The ONE masked-reduction helper shared
+    by the profiler and latency digests (traced inside both jits)."""
+    xm = x * wm
+    return jnp.stack([(xm >> 16).sum(0), (xm & 0xFFFF).sum(0)])
+
+
+def _masked_lane_pcts(x, on, n, qs=(50, 90, 100)):
+    """Per-lane percentiles of an int32[B] metric over the masked-ON
+    lanes: sort with masked lanes pushed to +inf and index at the
+    on-lane count, so a partially-masked batch never dilutes its own
+    statistics; an all-masked batch reads 0, not the sort sentinel.
+    Shared by `_profile_digest` and `_latency_digest` (q=100 = max)."""
+    v = jnp.sort(jnp.where(on, x, jnp.int32(2**31 - 1)))
+
+    def at(q):
+        i = jnp.clip((jnp.maximum(n, 1) - 1) * q // 100,
+                     0, x.shape[0] - 1)
+        return v[i]
+
+    return jnp.where(n > 0, jnp.stack([at(q) for q in qs]), 0)
+
+
 @jax.jit
 def _profile_digest(pf_dispatch, pf_busy, pf_kill, pf_restart, pf_qmax,
                     pf_drop, pf_delay, pf_on, steps, now):
@@ -171,36 +202,17 @@ def _profile_digest(pf_dispatch, pf_busy, pf_kill, pf_restart, pf_qmax,
     (cfg.profile, DESIGN §16): batch sums over the PROFILED lanes plus
     per-lane percentiles, so only the O(counters) summary crosses the
     host boundary — the same ship-summaries discipline as
-    `coverage_digest`. Percentiles are computed by sorting with masked
-    lanes pushed to +inf and indexing at the profiled-lane count, so a
-    partially-masked batch never dilutes its own statistics.
-
-    Batch sums are WIDE: int64 is unavailable without x64, and a plain
-    int32 sum of per-lane counters wraps at realistic scale (512 lanes
-    × ~1e7 busy ticks > 2^31) — exactly the wrapped-negative reading
-    the saturating per-lane counters exist to prevent. Each counter is
-    split into 16-bit halves and the halves summed separately (`_s64`);
-    the host recombines hi·2^16 + lo into exact Python ints. Half-sums
-    stay in-range for B ≤ 32767 lanes — far above any single-device
-    batch."""
+    `coverage_digest`. Counter-plane half of the digest family; the
+    latency histograms (cfg.latency_hist, r16) reduce through the
+    sibling `_latency_digest` — both ride the shared
+    `_masked_half_sums` / `_masked_lane_pcts` lane-mask plumbing."""
     onf = pf_on
     w = onf.astype(jnp.int32)
     n = w.sum()
-
-    def s64(x, wm):
-        # (hi_sum, lo_sum) over masked lanes; value = hi*65536 + lo
-        xm = x * wm
-        return jnp.stack([(xm >> 16).sum(0), (xm & 0xFFFF).sum(0)])
+    s64 = _masked_half_sums
 
     def pcts(x):
-        v = jnp.sort(jnp.where(onf, x, jnp.int32(2**31 - 1)))
-
-        def at(q):
-            i = jnp.clip((jnp.maximum(n, 1) - 1) * q // 100,
-                         0, x.shape[0] - 1)
-            return v[i]
-        # all-masked batches read the +inf fill — report 0, not sentinel
-        return jnp.where(n > 0, jnp.stack([at(50), at(90), at(100)]), 0)
+        return _masked_lane_pcts(x, onf, n)
 
     return dict(
         lanes=n,
@@ -274,6 +286,153 @@ def profile_counters(state) -> dict | None:
         busy_total_p90=int(d["busy_total_pct"][1]),
         busy_total_max=int(d["busy_total_pct"][2]),
     )
+
+
+# latency-plane bucket edges: bucket j of a cfg.latency_hist histogram
+# holds latencies in [edge(j), edge(j+1)) ticks with edge(0) = 0,
+# edge(j) = 2^(j-1) (core/step.py's exact integer bucketing rule)
+def latency_bucket_edges(buckets: int) -> np.ndarray:
+    """Lower edge of each log2 latency bucket, in ticks (int64[B]) —
+    the host-side table of `bucket_lower_edge`."""
+    return np.asarray([0] + [1 << j for j in range(buckets - 1)], np.int64)
+
+
+def bucket_lower_edge(b):
+    """Traced lower edge (ticks) of log2 bucket index `b` (int32): 0
+    for bucket 0, 2^(b-1) otherwise. The ONE encoding of the
+    bucket→edge rule — `_hist_quantiles` and `harness.slo.
+    _hist_quantile_edge` both use it, so the invariant can never fire
+    against a different edge than the one the reports print."""
+    return jnp.where(b == 0, 0,
+                     jnp.left_shift(jnp.int32(1), jnp.maximum(b - 1, 0)))
+
+
+def _hist_quantiles(hist_f, qs):
+    """Bucket-CDF quantile estimates for a [..., B] float32 histogram:
+    for each q, the LOWER EDGE of the bucket containing the ceil(q·total)-th
+    sample — a deterministic lower bound on the true quantile (so an
+    SLO comparison `estimate > target` can never fire on a value the
+    true quantile doesn't exceed). Counts are float32: totals can pass
+    2^31 (saturated int32 per-lane counts × lanes) and the comparison
+    against a float threshold is deterministic. Returns int32[..., Q];
+    an empty histogram reads 0."""
+    cdf = jnp.cumsum(hist_f, axis=-1)                     # [..., B]
+    total = cdf[..., -1:]                                 # [..., 1]
+    out = []
+    for q in qs:
+        need = jnp.ceil(total * q)
+        # first bucket whose cdf reaches the q-th sample
+        b = jnp.argmax(cdf >= jnp.maximum(need, 1.0), axis=-1).astype(
+            jnp.int32)
+        out.append(jnp.where(total[..., 0] > 0, bucket_lower_edge(b), 0))
+    return jnp.stack(out, axis=-1)
+
+
+_LAT_QS = (0.50, 0.90, 0.99, 0.999)
+_LAT_QNAMES = ("p50", "p90", "p99", "p999")
+
+
+@jax.jit
+def _latency_digest(lh_sojourn, lh_e2e, lh_slo_miss, lh_on):
+    """Device-side reduction of the SLO latency plane (cfg.latency_hist,
+    DESIGN §17): histogram MERGE over the recorded lanes (wide masked
+    sums — the shared `_masked_half_sums` plumbing) plus on-device
+    quantile estimation from the merged bucket CDFs. O(buckets)
+    crosses the host boundary, never the [B, N, buckets] lanes —
+    p50/p90/p99/p999 at sweep scale for the cost of one small
+    transfer at syncs the runners already pay."""
+    onf = lh_on
+    w = onf.astype(jnp.int32)
+    n = w.sum()
+    s64 = _masked_half_sums
+    # merged histograms as floats for the quantile CDFs (exactness for
+    # the counts themselves lives in the half-sums)
+    wf = onf.astype(jnp.float32)
+    soj_f = (lh_sojourn.astype(jnp.float32)
+             * wf[:, None, None]).sum(0)                  # [N, B]
+    e2e_f = (lh_e2e.astype(jnp.float32) * wf[:, None, None]).sum(0)
+    return dict(
+        lanes=n,
+        sojourn=s64(lh_sojourn, w[:, None, None]),        # [2, N, B]
+        e2e=s64(lh_e2e, w[:, None, None]),                # [2, N, B]
+        slo_miss=s64(lh_slo_miss, w[:, None]),            # [2, N]
+        # cluster-wide quantiles (all nodes folded) + per-node p99
+        sojourn_q=_hist_quantiles(soj_f.sum(0), _LAT_QS),  # [4]
+        e2e_q=_hist_quantiles(e2e_f.sum(0), _LAT_QS),      # [4]
+        e2e_p99_by_node=_hist_quantiles(e2e_f, (0.99,))[..., 0],  # [N]
+    )
+
+
+def latency_digest(state):
+    """Launch the device-side latency reduction over a batched state;
+    returns DEVICE arrays (force lazily) or None when the plane is
+    compiled out (cfg.latency_hist == 0) or the state is unbatched."""
+    lh = getattr(state, "lh_e2e", None)
+    if lh is None or lh.ndim != 3 or lh.shape[1] == 0 or lh.shape[2] == 0:
+        return None
+    return _latency_digest(state.lh_sojourn, state.lh_e2e,
+                           state.lh_slo_miss, state.lh_on)
+
+
+def latency_counters(state) -> dict | None:
+    """Materialize `latency_digest` host-side: exact merged histograms
+    (int64[N, B]), total SLO misses, and the quantile estimates in
+    ticks (µs). None when the plane is compiled out."""
+    d = latency_digest(state)
+    if d is None:
+        return None
+    d = {k: np.asarray(v) for k, v in d.items()}
+
+    def wide(a):
+        a = a.astype(np.int64)
+        return a[0] * 65536 + a[1]
+
+    out = dict(
+        lanes=int(d["lanes"]),
+        sojourn_hist=wide(d["sojourn"]),
+        e2e_hist=wide(d["e2e"]),
+        slo_miss_by_node=wide(d["slo_miss"]).tolist(),
+        slo_miss=int(wide(d["slo_miss"]).sum()),
+        e2e_p99_by_node=d["e2e_p99_by_node"].tolist(),
+    )
+    for i, nm in enumerate(_LAT_QNAMES):
+        out[f"sojourn_{nm}"] = int(d["sojourn_q"][i])
+        out[f"e2e_{nm}"] = int(d["e2e_q"][i])
+    return out
+
+
+@jax.jit
+def _lane_e2e_p99(lh_e2e):
+    """Per-LANE p99 estimate from each lane's own e2e histogram (nodes
+    folded): int32[B] bucket lower edges — the tail-latency signal the
+    fuzzer's corpus energy consumes (search/corpus.py lat_bonus).
+    Lanes with no completions read 0."""
+    hist = lh_e2e.astype(jnp.float32).sum(1)              # [B, BK]
+    return _hist_quantiles(hist, (0.99,))[..., 0]
+
+
+def lane_e2e_p99(state) -> np.ndarray | None:
+    """Host-side per-lane p99 (ticks) off the latency plane; None when
+    compiled out. One [B] int32 transfer — the per-lane attribution the
+    corpus needs, the same bill the sketch batch pays."""
+    lh = getattr(state, "lh_e2e", None)
+    if lh is None or lh.ndim != 3 or lh.shape[1] == 0 or lh.shape[2] == 0:
+        return None
+    return np.asarray(_lane_e2e_p99(state.lh_e2e))
+
+
+def latency_brief(state) -> dict | None:
+    """The small JSON-able latency rollup observer records and
+    `summarize()` carry: cluster p50/p99/p999, sojourn p99, SLO misses.
+    None when the plane is compiled out."""
+    c = latency_counters(state)
+    if c is None:
+        return None
+    return dict(lanes=c["lanes"],
+                e2e_p50=c["e2e_p50"], e2e_p99=c["e2e_p99"],
+                e2e_p999=c["e2e_p999"], sojourn_p99=c["sojourn_p99"],
+                slo_miss=c["slo_miss"],
+                completions=int(c["e2e_hist"].sum()))
 
 
 def schedule_representatives(state, seeds) -> dict:
@@ -362,10 +521,16 @@ def summarize(rt, state, seeds=None) -> dict:
         # interleaving classes; first_divergence says how early the
         # batch bought them.
         first_divergence=divergence_profile(state),
-        # where the cluster spent its effort (r15): the counter-plane
-        # rollup — None when cfg.profile is off. Arrays summarized to
-        # lists so the report stays JSON-able like everything else.
+        # where the cluster spent its effort (r15): the profiler digest
+        # rollup — counters AND, since r16, the latency-histogram
+        # quantiles ride the digest family; None when cfg.profile is
+        # off. Arrays summarized to lists so the report stays JSON-able
+        # like everything else.
         profile=_profile_brief(state),
+        # how long requests took (r16): cluster p50/p99/p999 + SLO
+        # misses off the latency plane — None when cfg.latency_hist
+        # is 0.
+        latency=latency_brief(state),
         oops=int((np.asarray(state.oops) != 0).sum()),
     )
 

@@ -218,7 +218,7 @@ class Runtime:
         return mask
 
     def init_batch(self, seeds, trace_lanes=None,
-                   profile_lanes=None) -> SimState:
+                   profile_lanes=None, latency_lanes=None) -> SimState:
         """Initial batched state for an array of seeds (replay-by-seed:
         the same seed always reproduces the same trajectory, the
         MADSIM_TEST_SEED contract of macros lib.rs:141-145).
@@ -235,6 +235,15 @@ class Runtime:
         masked-off build is the ship-with-it shape: profile=True
         compiled in, lanes flipped on only for the sweeps being
         profiled (bench.py --mode prof_ab bounds the masked cost).
+
+        latency_lanes: which lanes the SLO latency plane histograms
+        when cfg.latency_hist > 0 (None = all; same forms; bench.py
+        --mode lat_ab bounds the masked cost). NOTE: the root-time
+        column ev_root_t is maintained on every lane regardless — only
+        the histogram folds are gated — so flipping a lane on mid-
+        campaign needs no warm-up. A runtime whose `invariant=` is
+        harness.slo_invariant should keep every lane on: a masked lane
+        never folds, so its SLO can never fire.
         """
         seeds = jnp.atleast_1d(jnp.asarray(seeds, jnp.uint32))
         keys = jax.vmap(prng.seed_key)(seeds)
@@ -259,6 +268,15 @@ class Runtime:
             mask = self._lane_mask(profile_lanes, int(seeds.shape[0]),
                                    "profile_lanes")
             batched = batched.replace(pf_on=jnp.asarray(mask))
+        if latency_lanes is not None:
+            if self.cfg.latency_hist == 0:
+                raise ValueError(
+                    "latency_lanes given but cfg.latency_hist == 0 — the "
+                    "latency plane is compiled out; set "
+                    "SimConfig(latency_hist=...) > 0")
+            mask = self._lane_mask(latency_lanes, int(seeds.shape[0]),
+                                   "latency_lanes")
+            batched = batched.replace(lh_on=jnp.asarray(mask))
         return batched
 
     def init_single(self, seed: int) -> SimState:
@@ -441,11 +459,24 @@ class Runtime:
                 break
         if observer is not None:
             wall = time.perf_counter() - t0
-            observer.on_done(dict(
+            rec = dict(
                 kind="done", steps_done=done, batch=B, chunks=k,
                 lanes_halted=_halted_count(state),
                 wall_s=wall,
-                lane_steps_per_sec=B * done / max(wall, 1e-9)))
+                lane_steps_per_sec=B * done / max(wall, 1e-9))
+            if self.cfg.latency_hist > 0 and getattr(
+                    state.halted, "is_fully_addressable", True):
+                # the sweep's tail-latency rollup rides the final sync
+                # the observer already pays (O(buckets) transfer);
+                # skipped on non-addressable multi-process batches,
+                # like lanes_halted
+                from ..parallel.stats import latency_brief
+                lb = latency_brief(state)
+                if lb is not None:
+                    rec.update(lat_p50=lb["e2e_p50"],
+                               lat_p99=lb["e2e_p99"],
+                               slo_miss=lb["slo_miss"])
+            observer.on_done(rec)
         if collect_events and events:
             events = jax.tree.map(
                 lambda *xs: np.concatenate(xs, axis=0), *events)
@@ -626,6 +657,14 @@ class Runtime:
                     ev_prov=state.ev_prov.at[slot].set(
                         jnp.where(w, jnp.asarray([-1, 0], jnp.int32),
                                   state.ev_prov[slot])))
+            if cfg.latency_hist > 0:
+                # same external-cause contract for the latency plane:
+                # the injected op's root time (-1 = unset) is minted at
+                # its own dispatch, not inherited from the slot's
+                # previous occupant
+                lineage["ev_root_t"] = state.ev_root_t.at[slot].set(
+                    jnp.where(w, jnp.asarray(-1, jnp.int32),
+                              state.ev_root_t[slot]))
             return state.replace(
                 **lineage,
                 t_deadline=state.t_deadline.at[slot].set(
@@ -687,6 +726,19 @@ class Runtime:
         return state.replace(
             tlimit=jnp.full_like(state.tlimit, limit),
             t_deadline=jnp.where(auto, limit, state.t_deadline))
+
+    def set_slo_target(self, state: SimState, target: int) -> SimState:
+        """Retune every trajectory's SLO target (ticks; 0 disables the
+        miss counter) — slo_target is dynamic state like tlimit, so no
+        recompile. Requires the latency plane compiled in
+        (cfg.latency_hist > 0): a target with no histograms to miss
+        against would silently count nothing."""
+        if self.cfg.latency_hist == 0:
+            raise ValueError(
+                "set_slo_target needs cfg.latency_hist > 0 — the latency "
+                "plane is compiled out")
+        return state.replace(
+            slo_target=jnp.full_like(state.slo_target, int(target)))
 
     # ------------------------------------------------------------------
     def fingerprints(self, state: SimState) -> np.ndarray:

@@ -19,6 +19,14 @@ setting needs):
   - `schedule()` samples parents with probability proportional to energy,
     and keeps `fresh_frac` of each batch on the UNMUTATED base knobs — an
     exploration floor so the corpus never traps the sweep in one basin;
+  - (r16, opt-in) lanes whose OWN end-to-end latency p99 sits high get
+    an admission bonus scaled by how close to the round's worst tail
+    they are (up to x(1+lat_bonus)) — the divergence-bonus treatment
+    applied to TAIL AMPLIFICATION, so the fuzzer can hunt admissions
+    that push p99 up, not just ones that rewire the schedule. Fed by
+    the on-device latency plane (SimState.lh_e2e, cfg.latency_hist);
+    lat_bonus=0 (the default) keeps energy latency-blind and a build
+    without the plane is always blind regardless.
   - (r10) lanes that diverged from the campaign's consensus prefix EARLY
     get an admission bonus scaled by depth (up to x(1+div_bonus)),
     computed from the on-device prefix-coverage sketches
@@ -65,7 +73,8 @@ class Corpus:
     def __init__(self, plan: KnobPlan, rng=None, max_entries: int = 4096,
                  fresh_frac: float = 0.125, decay: float = 0.97,
                  reward: float = 1.5, energy_cap: float = 8.0,
-                 div_bonus: float = 1.0, worker_id: int = 0):
+                 div_bonus: float = 1.0, lat_bonus: float = 0.0,
+                 worker_id: int = 0):
         self.plan = plan
         self.rng = rng if rng is not None else np.random.default_rng(0)
         self.max_entries = int(max_entries)
@@ -74,6 +83,7 @@ class Corpus:
         self.reward = float(reward)
         self.energy_cap = float(energy_cap)
         self.div_bonus = float(div_bonus)   # 0 = sched_hash-only energy
+        self.lat_bonus = float(lat_bonus)   # 0 = latency-blind energy
         self.worker_id = int(worker_id)
         self.entries: list[dict] = []   # slot-stable: eviction replaces
         self._seen: set[int] = set()    # every hash ever admitted (dedupe)
@@ -211,7 +221,7 @@ class Corpus:
     # ------------------------------------------------------------------
     def observe(self, knobs_batch, seeds, hashes_u64, crashed, codes,
                 parent_ids, round_no: int, sketches=None,
-                last_op=None) -> dict:
+                last_op=None, lat_p99=None) -> dict:
         """Fold one harvested round into the corpus. `knobs_batch` is the
         HOST knob batch that ran, `hashes_u64` the per-lane schedule
         hashes, `parent_ids` the corpus entry id each lane mutated from
@@ -219,7 +229,10 @@ class Corpus:
         optional [B, S] prefix-coverage sketch batch (SimState.cov_sketch
         — enables the early-divergence admission bonus), `last_op` the
         optional int[B] per-lane LAST applied havoc operator
-        (KnobPlan.mutate's third output; -1 = untouched). Returns
+        (KnobPlan.mutate's third output; -1 = untouched), `lat_p99` the
+        optional int[B] per-lane end-to-end p99 estimate
+        (parallel.stats.lane_e2e_p99 — enables the opt-in tail-latency
+        admission bonus when self.lat_bonus > 0). Returns
         admission stats; with `last_op` given they include `op_yield` —
         admissions attributed by operator (int64[N_MUT_OPS + 1], last
         slot = "base"), summing exactly to `new`: which operators'
@@ -244,6 +257,14 @@ class Corpus:
                     n_slots = sk.shape[1]
                     div_slot = first_divergence_slots(
                         sk, consensus=self.consensus_sketch())
+        lat_rel = None
+        if lat_p99 is not None and self.lat_bonus > 0:
+            lp = np.asarray(lat_p99, np.float64)
+            lat_max = float(lp.max()) if lp.size else 0.0
+            if lat_max > 0:
+                # tail-amplification bonus scale: each lane's p99
+                # relative to the round's worst tail, in [0, 1]
+                lat_rel = lp / lat_max
         for e in self.entries:
             e["energy"] = max(0.05, e["energy"] * self.decay)
         for i in range(len(seeds)):
@@ -268,6 +289,12 @@ class Corpus:
                 # (j == n_slots — never diverged in-window — gets none)
                 slot = int(div_slot[i])
                 energy *= 1.0 + self.div_bonus * (n_slots - slot) / n_slots
+            if lat_rel is not None:
+                # tail-latency bonus (r16): a lane whose own p99 sits
+                # at the round's worst tail gets up to x(1 + lat_bonus)
+                # admission energy, linear in relative tail height —
+                # the divergence-bonus treatment for tail amplification
+                energy *= 1.0 + self.lat_bonus * float(lat_rel[i])
             entry = dict(id=self._next_id, hash=h, seed=int(seeds[i]),
                          knobs=KnobPlan.lane(knobs_batch, i),
                          energy=min(self.energy_cap, energy),

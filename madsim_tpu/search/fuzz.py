@@ -57,6 +57,16 @@ from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
 WORKER_SEED_STRIDE = 1 << 26
 
 
+def _lat_fields(lat_brief: dict) -> dict:
+    """The latency slice of a fuzz-round / done / metrics record
+    (obs/metrics.py schema) — ONE definition, so the round records and
+    the durable timeline rows can't silently diverge (the
+    apply_repro_knobs precedent). `search.shard` imports it too."""
+    return dict(lat_p50=lat_brief["e2e_p50"],
+                lat_p99=lat_brief["e2e_p99"],
+                slo_miss=lat_brief["slo_miss"])
+
+
 def _env_verify_resume() -> bool:
     """Default for the run-twice resume guard when the caller passed
     None: MADSIM_FUZZ_VERIFY_RESUME=1 turns it on fleet-wide (CI and
@@ -70,7 +80,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
          pipeline: bool = True, fused: bool = True, dup_slots: int = 2,
          havoc: int = 3, fresh_frac: float = 0.125, rng_seed: int = 0,
          observer=None, minimize: bool = False, corpus: Corpus | None = None,
-         div_bonus: float | None = None, corpus_dir: str | None = None,
+         div_bonus: float | None = None, lat_bonus: float | None = None,
+         corpus_dir: str | None = None,
          worker_id: int = 0, sync_every: int = 1,
          verify_resume: bool | None = None):
     """Coverage-guided schedule fuzzing over `rt`'s dynamic fault knobs.
@@ -91,7 +102,13 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     in, cfg.sketch_slots > 0 — see search/corpus.py; 0 restores
     sched_hash-only energy, a sketchless build is always hash-only
     regardless, and None keeps the corpus's setting — the default 1.0
-    for a fresh corpus, whatever a passed-in `corpus` was built with).
+    for a fresh corpus, whatever a passed-in `corpus` was built with),
+    lat_bonus (OPT-IN tail-latency admission bonus when the runtime
+    compiles the latency plane in, cfg.latency_hist > 0 — admissions
+    whose lane's own e2e p99 sits at the round's worst tail get up to
+    x(1+lat_bonus) energy, so the fuzzer hunts TAIL AMPLIFICATION; the
+    default None/0.0 keeps energy latency-blind, same None-keeps-
+    corpus-setting contract as div_bonus).
 
     Durable-campaign args (corpus_dir is the switch):
       corpus_dir   a service.CorpusStore directory (created on first
@@ -171,7 +188,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             corpus = store.load_corpus(
                 plan, worker_id=worker_id, rng_seed=rng_seed,
                 fresh_frac=fresh_frac,
-                div_bonus=1.0 if div_bonus is None else div_bonus)
+                div_bonus=1.0 if div_bonus is None else div_bonus,
+                lat_bonus=0.0 if lat_bonus is None else lat_bonus)
         else:
             if corpus.worker_id != worker_id:
                 # a mismatched namespace would persist a worker state
@@ -195,12 +213,16 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     if corpus is None:
         corpus = Corpus(plan, rng=np.random.default_rng(rng_seed),
                         fresh_frac=fresh_frac,
-                        div_bonus=1.0 if div_bonus is None else div_bonus)
-    elif div_bonus is not None:
-        # an explicit div_bonus must win over a passed-in corpus's
-        # setting — silently keeping the old value would skew any
-        # hash-only-vs-divergence comparison run through this arg
-        corpus.div_bonus = float(div_bonus)
+                        div_bonus=1.0 if div_bonus is None else div_bonus,
+                        lat_bonus=0.0 if lat_bonus is None else lat_bonus)
+    else:
+        # an explicit div_bonus/lat_bonus must win over a passed-in
+        # corpus's setting — silently keeping the old value would skew
+        # any with-vs-without energy comparison run through these args
+        if div_bonus is not None:
+            corpus.div_bonus = float(div_bonus)
+        if lat_bonus is not None:
+            corpus.lat_bonus = float(lat_bonus)
     master = jax.random.PRNGKey(np.uint32(rng_seed ^ 0x5EED5EED))
 
     def launch(r):
@@ -240,11 +262,21 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         hashes = stats.sched_hash_u64(state)
         sk = np.asarray(state.cov_sketch)
         sketches = sk if sk.ndim == 2 and sk.shape[1] > 0 else None
+        # tail-latency signal (r16): per-lane e2e p99 for corpus energy
+        # + the round's merged brief for telemetry — None on builds
+        # without the latency plane (one [B] + one O(buckets)
+        # transfer); the brief only when something will consume it
+        lat_p99 = stats.lane_e2e_p99(state)
+        lat_brief = (stats.latency_brief(state)
+                     if lat_p99 is not None
+                     and (observer is not None or store is not None)
+                     else None)
         if hist is not None:
             op_hist[:] += np.asarray(hist)
         return (seeds, ids, knobs_host, hashes,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
-                hist is not None, np.asarray(last_op), sketches, state)
+                hist is not None, np.asarray(last_op), sketches, state,
+                lat_p99, lat_brief)
 
     def verified(harvested):
         """The run-twice resume guard (verify_resume): re-dispatch the
@@ -256,9 +288,11 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         from ..utils.verify import agree_twice
 
         def key_of(h):
-            _, _, _, hashes, crashed, codes, _, _, sketches, _ = h
+            hashes, crashed, codes, sketches, lat_p99 = \
+                h[3], h[4], h[5], h[8], h[10]
             return (hashes.tobytes(), crashed.tobytes(), codes.tobytes(),
-                    None if sketches is None else sketches.tobytes())
+                    None if sketches is None else sketches.tobytes(),
+                    None if lat_p99 is None else lat_p99.tobytes())
 
         def again(prev):
             seeds, ids, knobs_host = prev[0], prev[1], prev[2]
@@ -303,11 +337,11 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         if r == verify_round:
             harvested = verified(harvested)
         (seeds, ids, knobs_host, hashes, crashed, codes,
-         mutated, last_op, sketches, state) = harvested
+         mutated, last_op, sketches, state, lat_p99, lat_brief) = harvested
         rounds += 1
         cstats = corpus.observe(knobs_host, seeds, hashes, crashed, codes,
                                 ids, r, sketches=sketches,
-                                last_op=last_op)
+                                last_op=last_op, lat_p99=lat_p99)
         yield_hist[:] += cstats["op_yield"]
         for i in np.nonzero(crashed)[0]:
             c = int(codes[i])
@@ -359,6 +393,10 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                           for i in range(len(YIELD_NAMES))},
                 corpus_energy=corpus.energy_summary(),
                 dry_rounds=dry, wall_s=time.perf_counter() - t0)
+            if lat_brief is not None:
+                # the round's tail (obs/metrics.py schema): merged e2e
+                # p50/p99 estimates + SLO misses for this round's batch
+                rec.update(_lat_fields(lat_brief))
             if buckets is not None:
                 rec["buckets_opened"] = len(opened_buckets)
             if sketches is not None:
@@ -380,12 +418,17 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             # (deduped by rounds_done in campaign_timeline), so the
             # durable timeline has no gaps and no double counts
             wall_now = wall_prior + time.perf_counter() - t0
-            store.append_metrics(worker_id, dict(
+            mrow = dict(
                 t=time.time(), worker=worker_id, rounds_done=r + 1,
                 coverage=len(seen), seeds_run=(r + 1) * batch,
                 crashes=n_crashed, corpus_size=len(corpus),
                 dry=dry, wall_s=round(wall_now, 3),
-                op_yield=[int(x) for x in yield_hist]))
+                op_yield=[int(x) for x in yield_hist])
+            if lat_brief is not None:
+                # the durable p99 timeline (campaign_report folds the
+                # rows into a p99_curve): this sync's round-batch tail
+                mrow.update(_lat_fields(lat_brief))
+            store.append_metrics(worker_id, mrow)
             store.sync(corpus, worker_id, rounds_done=r + 1, dry=dry,
                        op_hist=op_hist, op_yield=yield_hist,
                        wall_s=wall_now)

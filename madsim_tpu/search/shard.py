@@ -65,7 +65,7 @@ import numpy as np
 from ..parallel import stats
 from ..parallel.mesh import SEED_AXIS, seed_mesh
 from .corpus import Corpus, YIELD_NAMES, merge_consensus
-from .fuzz import WORKER_SEED_STRIDE, _env_verify_resume
+from .fuzz import WORKER_SEED_STRIDE, _env_verify_resume, _lat_fields
 from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
 
 
@@ -87,7 +87,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                  dup_slots: int = 2, havoc: int = 3,
                  fresh_frac: float = 0.125, rng_seed: int = 0,
                  observer=None, minimize: bool = False,
-                 div_bonus: float | None = None, merge_every: int = 1,
+                 div_bonus: float | None = None,
+                 lat_bonus: float | None = None, merge_every: int = 1,
                  corpus_dir: str | None = None, worker_id: int = 0,
                  sync_every: int = 1, verify_resume: bool | None = None):
     """Coverage-guided schedule fuzzing, sharded across a device mesh.
@@ -189,6 +190,7 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 plan, worker_id=eff_w[s], rng_seed=rng_seed + s,
                 fresh_frac=fresh_frac,
                 div_bonus=1.0 if div_bonus is None else div_bonus,
+                lat_bonus=0.0 if lat_bonus is None else lat_bonus,
                 state=(shard_states[s] if shard_states else None))
             c.track_admissions = True
             corpora.append(c)
@@ -201,12 +203,16 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         for s in range(S):
             c = Corpus(plan, rng=np.random.default_rng(rng_seed + s),
                        fresh_frac=fresh_frac, worker_id=eff_w[s],
-                       div_bonus=1.0 if div_bonus is None else div_bonus)
+                       div_bonus=1.0 if div_bonus is None else div_bonus,
+                       lat_bonus=0.0 if lat_bonus is None else lat_bonus)
             c.track_admissions = True
             corpora.append(c)
     if div_bonus is not None:
         for c in corpora:
             c.div_bonus = float(div_bonus)
+    if lat_bonus is not None:
+        for c in corpora:
+            c.lat_bonus = float(lat_bonus)
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     lane_sharding = NamedSharding(mesh, P(SEED_AXIS))
@@ -290,11 +296,20 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         digest = stats.digest_hashes(pairs, n)
         sk = np.asarray(state.cov_sketch)
         sketches = sk if sk.ndim == 2 and sk.shape[1] > 0 else None
+        # tail-latency signal (r16) — fuzz()'s harvest shape, so the
+        # 1-shard campaign's corpus energies stay byte-identical; the
+        # brief only when something will consume it
+        lat_p99 = stats.lane_e2e_p99(state)
+        lat_brief = (stats.latency_brief(state)
+                     if lat_p99 is not None
+                     and (observer is not None or stores is not None)
+                     else None)
         if hist is not None:
             op_hist[:] += np.asarray(hist)
         return (seeds, ids, knobs_host, hashes, digest,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
-                mutated, np.asarray(last_op), sketches, state)
+                mutated, np.asarray(last_op), sketches, state,
+                lat_p99, lat_brief)
 
     def do_merge():
         """The cross-shard exchange: admissions since the last merge
@@ -317,7 +332,7 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                     corpora[s].admit_foreign(e)
         tally = merge_consensus(corpora, tally)
 
-    def sync_group(rounds_done, dry_now, wall_s):
+    def sync_group(rounds_done, dry_now, wall_s, lat_brief=None):
         do_merge()
         merged = 0
         for s in range(S):
@@ -326,13 +341,16 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         # timeline row BEFORE the group commit (fuzz()'s ordering: a
         # kill between the two re-appends an identical row on resume;
         # campaign_timeline dedups by rounds_done)
-        stores[0].append_metrics(worker_id, dict(
+        mrow = dict(
             t=time.time(), worker=worker_id, shards=S,
             rounds_done=rounds_done, coverage=len(seen),
             seeds_run=rounds_done * batch * S, crashes=n_crashed,
             corpus_size=sum(len(c) for c in corpora),
             dry=dry_now, wall_s=round(wall_s, 3),
-            op_yield=[int(x) for x in yield_hist]), group=True)
+            op_yield=[int(x) for x in yield_hist])
+        if lat_brief is not None:
+            mrow.update(_lat_fields(lat_brief))
+        stores[0].append_metrics(worker_id, mrow, group=True)
         stores[0].write_shard_group_state(
             corpora, worker_id=worker_id, shards=S,
             rounds_done=rounds_done, dry=dry_now, op_hist=op_hist,
@@ -375,7 +393,7 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             harvested = _verified_harvest(
                 rt, plan, harvested, harvest, max_steps, chunk, fused, mesh)
         (seeds, ids, knobs_host, hashes, digest, crashed, codes,
-         mutated, last_op, sketches, state) = harvested
+         mutated, last_op, sketches, state, lat_p99, lat_brief) = harvested
         rounds += 1
         corpus_size = 0
         per_shard_rows = []
@@ -387,7 +405,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             cstats = corpora[s].observe(
                 {k: v[lo:hi] for k, v in knobs_host.items()},
                 seeds[lo:hi], hashes[lo:hi], crashed[lo:hi], codes[lo:hi],
-                ids[lo:hi], r, sketches=sk_s, last_op=last_op[lo:hi])
+                ids[lo:hi], r, sketches=sk_s, last_op=last_op[lo:hi],
+                lat_p99=(lat_p99[lo:hi] if lat_p99 is not None else None))
             round_yield += cstats["op_yield"]
             shard_seen[s] |= set(hashes[lo:hi].tolist())
             corpus_size += cstats["size"]
@@ -453,6 +472,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 op_yield={YIELD_NAMES[i]: int(round_yield[i])
                           for i in range(len(YIELD_NAMES))},
                 dry_rounds=dry, wall_s=time.perf_counter() - t0)
+            if lat_brief is not None:
+                rec.update(_lat_fields(lat_brief))
             if buckets is not None:
                 rec["buckets_opened"] = len(opened_buckets)
             if sketches is not None:
@@ -468,7 +489,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         stopping = dry >= dry_rounds or r + 1 == max_rounds
         if stores is not None and (at_merge or stopping):
             sync_group(r + 1, dry,
-                       wall_prior + time.perf_counter() - t0)
+                       wall_prior + time.perf_counter() - t0,
+                       lat_brief=lat_brief)
         elif stores is None and (at_merge or stopping):
             do_merge()
         if dry >= dry_rounds:
@@ -547,9 +569,11 @@ def _verified_harvest(rt, plan, harvested, harvest_fn, max_steps, chunk,
     from ..utils.verify import agree_twice
 
     def key_of(h):
-        _, _, _, hashes, digest, crashed, codes, _, _, sketches, _ = h
+        hashes, crashed, codes, sketches, lat_p99 = \
+            h[3], h[5], h[6], h[9], h[11]
         return (hashes.tobytes(), crashed.tobytes(), codes.tobytes(),
-                None if sketches is None else sketches.tobytes())
+                None if sketches is None else sketches.tobytes(),
+                None if lat_p99 is None else lat_p99.tobytes())
 
     def again(prev):
         # prev is a HARVESTED tuple: (seeds, ids, knobs_host, hashes,

@@ -215,6 +215,10 @@ def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
                        over wall time (running max over workers' views)
       rate_curve       [[t_rel_s, schedules_per_sec]] — coverage/wall
                        at each sync
+      p99_curve        [[t_rel_s, lat_p99_us]] — the campaign's
+                       end-to-end p99 over wall time, from rows whose
+                       worker ran with the SLO latency plane compiled
+                       in (cfg.latency_hist > 0, r16); empty otherwise
       workers_health   {label: {last_seen, age_s, rounds_done, sync_gap_s,
                        stale}} — `stale` means no row within
                        `stale_after` × the worker's own observed sync
@@ -257,6 +261,7 @@ def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
     t0 = rows[0].get("t", 0.0) if rows else 0.0
     coverage_curve = []
     rate_curve = []
+    p99_curve = []
     cov = 0
     # schedules/s uses campaign_stats' denominator rule at each point in
     # time: campaign coverage over the MAX of the workers' own wall
@@ -274,8 +279,11 @@ def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
         wall = max(wall_by_worker.values(), default=0.0)
         if wall:
             rate_curve.append([t_rel, round(cov / wall, 2)])
+        if r.get("lat_p99") is not None:
+            p99_curve.append([t_rel, int(r["lat_p99"])])
     return dict(timeline=rows, coverage_curve=coverage_curve,
-                rate_curve=rate_curve, workers_health=health)
+                rate_curve=rate_curve, p99_curve=p99_curve,
+                workers_health=health)
 
 
 def campaign_report(corpus_dir: str, uptime_s: float = 0.0,
@@ -284,9 +292,9 @@ def campaign_report(corpus_dir: str, uptime_s: float = 0.0,
     """The merged truth of a campaign dir: coverage, per-worker rounds,
     crash buckets AFTER the read-side suffix merge (so the count is
     bugs, not bucket-open races), and the durable timeline
-    (`campaign_timeline` — coverage/schedules-per-sec curves + per-worker
-    last-seen health, with stale workers FLAGGED rather than their last
-    counters silently reported as current)."""
+    (`campaign_timeline` — coverage/schedules-per-sec/p99 curves +
+    per-worker last-seen health, with stale workers FLAGGED rather than
+    their last counters silently reported as current)."""
     store = CorpusStore(corpus_dir, create=False)
     stats = campaign_stats(corpus_dir, uptime_s=uptime_s, workers=workers,
                            store=store)
@@ -299,6 +307,7 @@ def campaign_report(corpus_dir: str, uptime_s: float = 0.0,
         timeline=tl["timeline"],
         coverage_curve=tl["coverage_curve"],
         rate_curve=tl["rate_curve"],
+        p99_curve=tl["p99_curve"],
         workers_health=tl["workers_health"],
         stale_workers=sorted(w for w, h in tl["workers_health"].items()
                              if h["stale"]),

@@ -66,6 +66,13 @@ case "$tier" in
     # next to the instants, and fuzz rounds must report per-operator
     # coverage yield summing to each round's admissions
     python bench.py --prof-smoke
+    # SLO latency-plane smoke: the on-device e2e histograms must equal a
+    # host parent-walk of the flight-recorder ring (root-inheritance
+    # rule end to end), the plane on/masked/compiled-out must be
+    # bit-identical, slo_invariant must crash deterministically with
+    # CRASH_SLO and replay by seed, and the Perfetto export must carry
+    # the rolling per-node e2e-p99 track
+    python bench.py --lat-smoke
     # DetSan smoke: the repo-wide determinism lint gate must be clean,
     # a seeded schedule race must confirm via the forced-commute PCT
     # nudge with a replayable (seed, knobs, nudge) repro and dedupe

@@ -67,6 +67,9 @@ class TestStructuralSignature:
         dict(table_dtype="int16"), dict(emission_write="onehot"),
         dict(collect_stats=False), dict(trace_cap=8),
         dict(net=NetConfig(op_jitter_max=3)),   # the static jitter GATE
+        dict(latency_hist=16),                  # the r16 latency plane
+        dict(latency_hist=16, complete_kinds=((1, 7),)),
+        dict(latency_hist=16, root_kinds=((2, 4),)),
     ])
     def test_structural_fields_key_compiles(self, kw):
         base = SimConfig(n_nodes=3)
@@ -77,6 +80,13 @@ class TestStructuralSignature:
         a = SimConfig(n_nodes=3, net=NetConfig(op_jitter_max=3))
         b = SimConfig(n_nodes=3, net=NetConfig(op_jitter_max=7))
         assert a.structural_signature() == b.structural_signature()
+
+    def test_slo_target_is_dynamic(self):
+        # the SLO target rides SimState (retune/fuzz without recompile)
+        a = SimConfig(n_nodes=3, latency_hist=16, slo_target=100)
+        b = SimConfig(n_nodes=3, latency_hist=16, slo_target=9000)
+        assert a.structural_signature() == b.structural_signature()
+        assert a.hash() != b.hash()     # hash() covers every field
 
     def test_trace_cap_buckets(self):
         assert next_pow2(0) == 0 and next_pow2(1) == 1
