@@ -742,6 +742,61 @@ def _make_racy_runtime(trace_cap=256, sketch_slots=0):
                                sync_wal=False, scenario=sc, cfg=cfg)
 
 
+def _make_grayfail_runtime(recipe="mix", trace_cap=128, n_ops=12):
+    """The gray-failure flagship targets (r17, DESIGN §18): Percolator-
+    lite (models/percolator.py) under the chaos recipes whose fault
+    shapes its snapshot-isolation oracle is built to catch. One
+    canonical definition — --grayfail-smoke, --regression-smoke, the
+    search_ab grayfail regime, and tests/test_grayfail.py import it.
+
+      mix    all four families composed on one knob plane (the fuzz
+             regime: asym cut, two drifting clocks, a slow disk, a torn
+             kill — every row/value/direction mutable); group-commit
+             (sync_commits=False) so kills are crash-rich
+      skew   fast clocks on both shards + fat latency — skewed lease
+             expiry rolls back live locks (CRASH_SNAPSHOT)
+      asym   inbound one-way cut to shard 1 — lazy secondary commits
+             vanish while everything else flows
+      disk   slow disk on shard 0 — commit acks outrun the client
+             timeout, rollback races the committed primary
+      torn   torn-write kill of shard 1 under group commit — recovery
+             sees a partially-written final record
+    """
+    from madsim_tpu import NetConfig, Scenario, SimConfig, ms, sec
+    from madsim_tpu.models.percolator import make_percolator_runtime
+    from madsim_tpu.runtime import chaos
+    sc = Scenario()
+    sync = True
+    if recipe == "mix":
+        sync = False
+        sc.at(ms(5)).set_latency(ms(8), ms(25))
+        sc = chaos.clock_drift(ms(20), 400, node=0, until=ms(900), sc=sc)
+        sc = chaos.clock_drift(ms(30), -350, node=1, until=ms(900), sc=sc)
+        sc = chaos.asymmetric_partition(ms(150), [1], ms(250),
+                                        direction=1, sc=sc)
+        sc = chaos.slow_disk(ms(350), ms(20), ms(600), node=0, sc=sc)
+        sc = chaos.torn_write_kill(ms(650), 1, down=ms(120), sc=sc)
+    elif recipe == "skew":
+        sc.at(ms(5)).set_latency(ms(15), ms(35))
+        sc = chaos.clock_drift(ms(10), 480, node=0, sc=sc)
+        sc = chaos.clock_drift(ms(10), 480, node=1, sc=sc)
+    elif recipe == "asym":
+        sc = chaos.asymmetric_partition(ms(150), [1], ms(300),
+                                        direction=1, sc=sc)
+    elif recipe == "disk":
+        sc = chaos.slow_disk(ms(100), ms(20), ms(700), node=0, sc=sc)
+    else:
+        assert recipe == "torn", recipe
+        sync = False
+        sc = chaos.torn_write_kill(ms(150), 1, down=ms(100), sc=sc)
+    cfg = SimConfig(n_nodes=5, event_capacity=256, payload_words=8,
+                    time_limit=sec(10), trace_cap=trace_cap,
+                    net=NetConfig(send_latency_min=ms(1),
+                                  send_latency_max=ms(8)))
+    return make_percolator_runtime(n_clients=3, n_ops=n_ops,
+                                   sync_commits=sync, scenario=sc, cfg=cfg)
+
+
 def _search_ab_mode():
     """--mode search_ab: coverage-guided fuzzer vs blind explore() at
     EQUAL device-dispatch budget (same rounds x batch x max_steps), on
@@ -773,6 +828,22 @@ def _search_ab_mode():
     CPU the virtual mesh is forced up front (honest CPU numbers until
     the TPU tunnel answers — the on-chip variant is on the ROADMAP
     wishlist); batch must divide by N."""
+    regime_filter = None
+    if "--regime" in sys.argv:
+        regime_filter = sys.argv[sys.argv.index("--regime") + 1]
+        known = ("saturating", "flagship_raft_chaos", "crashrich_wal_kv",
+                 "crashrich_chain", "grayfail")
+        if not any(n == regime_filter or n.startswith(regime_filter)
+                   for n in known):
+            # a typo must not run zero regimes, write no artifact, and
+            # exit green
+            sys.exit(f"unknown --regime {regime_filter!r} "
+                     f"(known: {list(known)} or a prefix)")
+
+    def want(name):
+        return (regime_filter is None or name == regime_filter
+                or name.startswith(regime_filter))
+
     shards = 1
     if "--shards" in sys.argv:
         shards = int(sys.argv[sys.argv.index("--shards") + 1])
@@ -860,29 +931,104 @@ def _search_ab_mode():
             / max(row["blind"]["distinct_schedules"], 1), 2)
         out["regimes"][name] = row
 
-    ab("saturating", _make_saturating_runtime,
-       rounds=6, batch=128, steps=1500, chunk=256)
+    if want("saturating"):
+        ab("saturating", _make_saturating_runtime,
+           rounds=6, batch=128, steps=1500, chunk=256)
     big = platform != "cpu"
-    ab("flagship_raft_chaos", _make_runtime,
-       rounds=3, batch=512 if big else 256,
-       steps=1024 if big else 512, chunk=256)
+    if want("flagship_raft_chaos"):
+        ab("flagship_raft_chaos", _make_runtime,
+           rounds=3, batch=512 if big else 256,
+           steps=1024 if big else 512, chunk=256)
     # crash-RICH flagships (the r9 open item): wal_kv lost-write and
     # chain lease/ordering crashes make crash_codes_per_device_sec a
     # real comparison instead of green Raft's near-zero
     for kind, steps_cr in (("wal_kv", 4096), ("chain", 3072)):
-        ab(f"crashrich_{kind}",
-           functools.partial(_make_crashrich_runtime, kind),
-           rounds=3, batch=128 if big else 64, steps=steps_cr, chunk=512)
-    sat = out["regimes"]["saturating"]
-    out["fuzzer_beats_blind_on_saturating"] = (
-        sat["fuzzer"]["distinct_schedules"]
-        > sat["blind"]["distinct_schedules"])
-    suffix = f"_shards{shards}" if shards > 1 else ""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        f"BENCH_search_ab_{platform}{suffix}.json")
-    with open(path, "w") as f:
-        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
-                  indent=1)
+        if want(f"crashrich_{kind}"):
+            ab(f"crashrich_{kind}",
+               functools.partial(_make_crashrich_runtime, kind),
+               rounds=3, batch=128 if big else 64, steps=steps_cr,
+               chunk=512)
+    if want("grayfail"):
+        # the r17 gray-failure regime: fuzzer vs blind on the
+        # Percolator-lite flagship under the composed fault mix. The
+        # fuzzer side runs DURABLY (a throwaway corpus dir) so crashes
+        # dedup into causal-fingerprint buckets — buckets per
+        # device-second is the regime's headline; blind explore() has
+        # no bucket machinery, so its distinct CRASH CODES stand in as
+        # the (coarser) lower bound, noted in the artifact.
+        import shutil
+        import tempfile
+        rounds_g, batch_g, steps_g = 4, 128 if big else 96, 20_000
+        row = {"rounds": rounds_g, "batch": batch_g, "max_steps": steps_g,
+               "note": ("fuzzer side is a durable campaign: crashes "
+                        "dedup by causal fingerprint into buckets; "
+                        "blind has no bucket machinery — its "
+                        "distinct_crash_codes is the coarser stand-in")}
+        warm = _make_grayfail_runtime("mix")
+        explore(warm, max_steps=steps_g, batch=batch_g, max_rounds=1,
+                dry_rounds=2, chunk=512)
+        fuzz(warm, max_steps=steps_g, batch=batch_g, max_rounds=2,
+             dry_rounds=3, chunk=512)
+        rt_b = _make_grayfail_runtime("mix")
+        t0 = time.perf_counter()
+        res_b = explore(rt_b, max_steps=steps_g, batch=batch_g,
+                        max_rounds=rounds_g, dry_rounds=rounds_g + 1,
+                        chunk=512)
+        dt_b = time.perf_counter() - t0
+        row["blind"] = {
+            "distinct_schedules": res_b["distinct_schedules"],
+            "distinct_crash_codes": len(res_b["crash_first_seed_by_code"]),
+            "wall_s": round(dt_b, 2),
+            "schedules_per_device_sec": round(
+                res_b["distinct_schedules"] / dt_b, 1)}
+        tmp = tempfile.mkdtemp(prefix="grayfail_ab_")
+        try:
+            rt_f = _make_grayfail_runtime("mix")
+            t0 = time.perf_counter()
+            res_f = fuzz(rt_f, max_steps=steps_g, batch=batch_g,
+                         max_rounds=rounds_g, dry_rounds=rounds_g + 1,
+                         chunk=512, corpus_dir=tmp)
+            dt_f = time.perf_counter() - t0
+            row["fuzzer"] = {
+                "distinct_schedules": res_f["distinct_schedules"],
+                "distinct_crash_codes": len(res_f["crash_repros"]),
+                "crash_buckets": res_f["buckets_total"],
+                "wall_s": round(dt_f, 2),
+                "schedules_per_device_sec": round(
+                    res_f["distinct_schedules"] / dt_f, 1),
+                "crash_buckets_per_device_sec": round(
+                    res_f["buckets_total"] / dt_f, 3),
+                "mutation_yield": res_f["mutation_yield"]}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        row["fuzzer_vs_blind_schedules"] = round(
+            row["fuzzer"]["distinct_schedules"]
+            / max(row["blind"]["distinct_schedules"], 1), 2)
+        out["regimes"]["grayfail"] = row
+        print(f"--search-ab: grayfail fuzzer "
+              f"{row['fuzzer']['distinct_schedules']} schedules / "
+              f"{row['fuzzer']['crash_buckets']} buckets vs blind "
+              f"{row['blind']['distinct_schedules']}", file=sys.stderr)
+        gpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             f"BENCH_grayfail_ab_{platform}.json")
+        with open(gpath, "w") as f:
+            json.dump(dict({"metric": "grayfail_ab",
+                            "platform": platform, "grayfail": row},
+                           measured_at=time.strftime("%F %T")), f,
+                      indent=1)
+    if "saturating" in out["regimes"]:
+        sat = out["regimes"]["saturating"]
+        out["fuzzer_beats_blind_on_saturating"] = (
+            sat["fuzzer"]["distinct_schedules"]
+            > sat["blind"]["distinct_schedules"])
+    if regime_filter is None:
+        # a filtered run must not clobber the full-matrix artifact
+        suffix = f"_shards{shards}" if shards > 1 else ""
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"BENCH_search_ab_{platform}{suffix}.json")
+        with open(path, "w") as f:
+            json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                      indent=1)
     print(json.dumps(out))
 
 
@@ -916,6 +1062,147 @@ def _search_smoke_mode():
         "blind_schedules": blind["distinct_schedules"],
         "mutation_ops_used": len(used),
         "pct_distinct": ps["distinct_schedules"],
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
+def _grayfail_smoke_mode():
+    """--grayfail-smoke: seconds-scale gray-failure-plane self-test for
+    CI (scripts/ci.sh fast):
+
+      1. a ONE-WAY cut is observed asymmetrically by gossip — the same
+         group with the direction flag flipped either starves the
+         cluster of node 0's rumors or lets them all through;
+      2. skewed lease expiry on the Percolator-lite flagship crashes
+         the snapshot-isolation oracle AND reproduces on seed replay
+         (same crash code, same fingerprint, single-lane);
+      3. a small durable fuzz campaign on the torn-write recipe opens
+         >= 1 causal-fingerprint crash bucket whose (seed, knobs)
+         handle replays red via replay_bucket.
+    """
+    _force_cpu_inprocess()
+    import shutil
+    import tempfile
+    import numpy as np
+    from madsim_tpu import (Scenario, SimConfig, fuzz, ms, replay_bucket,
+                            sec)
+    from madsim_tpu.models.gossip import make_gossip_runtime
+    from madsim_tpu.models.percolator import CRASH_SNAPSHOT
+    t0 = time.perf_counter()
+
+    # 1. gossip sees the cut asymmetrically
+    def gossip_have(direction):
+        sc = Scenario()
+        sc.at(0).partition_oneway([0], direction=direction)
+        cfg = SimConfig(n_nodes=6, event_capacity=192, time_limit=sec(2))
+        rt = make_gossip_runtime(n_nodes=6, scenario=sc, cfg=cfg)
+        fin = rt.run_fused(rt.init_batch(np.arange(8, dtype=np.uint32)),
+                           6_000, 256)
+        return np.asarray(fin.node_state["have"])
+    have_out = gossip_have(0)      # node 0's sends vanish
+    have_in = gossip_have(1)       # node 0 hears nothing, sends fine
+    full = (1 << 4) - 1
+    assert (have_out[:, 1:] == 0).all(), \
+        "outbound cut: rumors must never leave node 0"
+    assert (have_in == full).all(), \
+        "inbound cut: dissemination must be unaffected"
+
+    # 2. skewed lease expiry crashes the SI oracle and replays by seed
+    rt = _make_grayfail_runtime("skew")
+    fin = rt.run_fused(rt.init_batch(np.arange(192, dtype=np.uint32)),
+                       80_000, 512)
+    codes = np.asarray(fin.crash_code)
+    lanes = np.nonzero(codes == CRASH_SNAPSHOT)[0]
+    assert lanes.size > 0, "skew recipe found no CRASH_SNAPSHOT lane"
+    lane = int(lanes[0])
+    fp_batch = int(rt.fingerprints(fin)[lane])
+    rt2 = _make_grayfail_runtime("skew")
+    rep = rt2.run_fused(rt2.init_batch(np.asarray([lane], np.uint32)),
+                        80_000, 512)
+    assert int(np.asarray(rep.crash_code)[0]) == CRASH_SNAPSHOT
+    assert int(rt2.fingerprints(rep)[0]) == fp_batch, \
+        "seed replay diverged from the batch lane"
+
+    # 3. torn-write crash buckets by causal fingerprint, replayable
+    tmp = tempfile.mkdtemp(prefix="grayfail_smoke_")
+    try:
+        rt3 = _make_grayfail_runtime("torn")
+        res = fuzz(rt3, max_steps=40_000, batch=64, max_rounds=3,
+                   dry_rounds=4, chunk=512, corpus_dir=tmp)
+        assert res["buckets_total"] >= 1, res
+        for key in res["buckets_opened"] or []:
+            crashed, code, _ = replay_bucket(rt3, tmp, key, 40_000)
+            assert crashed, (key, code)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "metric": "grayfail_smoke", "platform": "cpu", "ok": True,
+        "skew_crash_lanes": int(lanes.size),
+        "torn_buckets": res["buckets_total"],
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
+def _regression_smoke_mode():
+    """--regression-smoke: the durable corpus as a REGRESSION SUITE
+    (OSS-Fuzz-style, r17): tests/data/regression_corpus/ holds committed
+    campaign dirs — known crash buckets + the corpus that found them.
+    Every bucket must still reproduce (replay_bucket with the run-twice
+    verify guard), and the top-energy corpus slice must still land on
+    its recorded schedule hashes — a silent engine change that rewires
+    replay shows up here before it ships."""
+    _force_cpu_inprocess()
+    import importlib
+    import numpy as np
+    from madsim_tpu import KnobPlan, replay_bucket
+    from madsim_tpu.parallel import stats
+    from madsim_tpu.service.store import CorpusStore, store_signature
+    t0 = time.perf_counter()
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tests", "data", "regression_corpus")
+    names = sorted(n for n in os.listdir(base)
+                   if os.path.isdir(os.path.join(base, n)))
+    assert names, f"no regression corpus committed under {base}"
+    checked = dict(buckets=0, entries=0)
+    for name in names:
+        d = os.path.join(base, name)
+        with open(os.path.join(d, "REGRESSION.json")) as f:
+            man = json.load(f)
+        mod, fn = man["factory"].split(":")
+        rt = getattr(importlib.import_module(mod), fn)(
+            **man.get("factory_kwargs", {}))
+        dup = int(man.get("dup_slots", 2))
+        steps = int(man["max_steps"])
+        plan = KnobPlan.from_runtime(rt, dup_slots=dup)
+        # signature check: a structurally different engine refuses the
+        # dir instead of replaying knobs onto the wrong rows
+        store = CorpusStore(d, signature=store_signature(rt, plan),
+                            create=False)
+        keys = store.bucket_keys()
+        missing = set(man["buckets"]) - set(keys)
+        assert not missing, f"{name}: recorded buckets missing: {missing}"
+        for key in keys:
+            crashed, code, _ = replay_bucket(rt, d, key, steps,
+                                             dup_slots=dup, verify=True)
+            assert crashed, (f"{name}/{key}: bucket no longer "
+                             f"reproduces (code={code})")
+            checked["buckets"] += 1
+        # top-energy corpus slice: recorded (seed, knobs) -> recorded
+        # sched_hash, bit-for-bit
+        ws = store.load_worker_state(0)
+        order = sorted(ws.get("order", []), key=lambda e: -e[1])[:8]
+        for eid, _en in order:
+            ent = store.load_entry(store._entry_name(int(eid)))
+            state = plan.apply(
+                rt.init_batch(np.asarray([ent["seed"]], np.uint32)),
+                KnobPlan.stack([ent["knobs"]]))
+            fin = rt.run_fused(state, steps, 512)
+            got = int(stats.sched_hash_u64(fin)[0])
+            assert got == ent["hash"], (
+                f"{name}: entry {eid} replayed to schedule {got:#x}, "
+                f"recorded {ent['hash']:#x}")
+            checked["entries"] += 1
+    print(json.dumps({
+        "metric": "regression_smoke", "platform": "cpu", "ok": True,
+        "campaigns": len(names), **checked,
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
@@ -2502,13 +2789,20 @@ def main():
                  "--causal-ab", "--causal-smoke", "--campaign",
                  "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
                  "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke",
-                 "--lat-ab", "--lat-smoke"}
+                 "--lat-ab", "--lat-smoke", "--grayfail-smoke",
+                 "--regression-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
         sys.argv.append(flag)
     if "--analyze-smoke" in sys.argv:
         _analyze_smoke_mode()
+        return
+    if "--grayfail-smoke" in sys.argv:
+        _grayfail_smoke_mode()
+        return
+    if "--regression-smoke" in sys.argv:
+        _regression_smoke_mode()
         return
     if "--prof-ab" in sys.argv:
         _prof_ab_mode()

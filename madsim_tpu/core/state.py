@@ -124,12 +124,47 @@ class SimState:
 
     # --- network fault matrix (NetSim analog) ----------------------------
     clog_node: jax.Array    # bool[N] — NetSim::clog_node
-    clog_link: jax.Array    # bool[N, N] — NetSim::clog_link (src, dst)
+    clog_link: jax.Array    # bool[N, N] — NetSim::clog_link (src, dst);
+                            # OP_PARTITION_ONEWAY (r17) ORs directional
+                            # cuts into it — asymmetric partitions are
+                            # just an asymmetric matrix
     loss: jax.Array         # float32 — packet_loss_rate
     lat_lo: jax.Array       # int32 ticks — send_latency range
     lat_hi: jax.Array       # int32 ticks
     jitter: jax.Array       # int32 ticks — per-op micro-jitter bound
                             # (NetConfig.op_jitter_max; net/mod.rs:151-156)
+
+    # --- gray-failure fault plane (r17; DESIGN §18) ------------------------
+    # All three are DYNAMIC replay-domain state (they change trajectories,
+    # so they ride in fingerprints and checkpoints — simconfig-v5 rejects
+    # pre-r17 snapshots): always compiled in, exact identity at the zero
+    # defaults (the bit-identical-to-r16 contract tests/test_grayfail.py
+    # holds against captured golden digests). Set by scenario ops
+    # (OP_SET_SKEW / OP_SET_DISK), mutated by the fuzzer's fault_perturb
+    # havoc operator through the scenario rows.
+    skew: jax.Array         # int32[N] — per-node clock-RATE skew in
+                            # 1/1024ths: node n's local clock reads
+                            # now + (now·skew[n])>>10 (handlers observe it
+                            # as ctx.now) and its timer delays shrink or
+                            # stretch inversely — a fast clock fires
+                            # timeouts early in global time, the
+                            # lease-expiry/timeout-ordering gray failure.
+                            # Exact integer arithmetic (no float log/mul):
+                            # deterministic, identity at 0.
+    disk_lat: jax.Array     # int32[N] — slow-disk emission delay in ticks:
+                            # every send latency and timer deadline the
+                            # node emits is pushed this much later (an
+                            # fsync-stalled event loop emits late). 0 = no
+                            # fault.
+    torn: jax.Array         # bool[N] — torn-write-on-kill mode: a KILL of
+                            # this node flushes a random prefix of each
+                            # fs file's unsynced tail to the durable view
+                            # before process memory dies, so recovery can
+                            # observe a partially-written final record
+                            # (fs-layer state schemas only; inert
+                            # otherwise). The tear draw rides a key split
+                            # the step already made, so enabling it never
+                            # shifts the PRNG stream of anything else.
 
     # --- schedule search (search/pct.py) ----------------------------------
     prio_nudge: jax.Array   # int32 — PCT-style priority-perturbation point.
@@ -319,6 +354,9 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         lat_lo=jnp.asarray(cfg.net.send_latency_min, i32),
         lat_hi=jnp.asarray(cfg.net.send_latency_max, i32),
         jitter=jnp.asarray(cfg.net.op_jitter_max, i32),
+        skew=jnp.zeros((N,), i32),
+        disk_lat=jnp.zeros((N,), i32),
+        torn=jnp.zeros((N,), bool),
         prio_nudge=jnp.asarray(0, i32),
         msg_sent=jnp.asarray(0, i32),
         msg_delivered=jnp.asarray(0, i32),

@@ -50,6 +50,17 @@ def _sat_add(a, d):
     return jnp.where(a > _I32_MAX - d, _I32_MAX, a + d)
 
 
+def _drift(t, sk):
+    """(t * sk) >> 10 in exact int32-safe pieces — the clock-skew fold
+    (DESIGN §18). `t` is a nonnegative tick count (now, or a timer
+    delay), `sk` a per-1024 rate deviation bounded by ±SKEW_CAP (512),
+    so (t>>10) ≤ 2^21 times 512 and (t&1023)*512 both stay far inside
+    int32. Exact integer arithmetic — no float rounding to leak
+    nondeterminism across backends — and identically 0 at sk == 0 (the
+    bit-identical-when-disabled contract)."""
+    return (t >> 10) * sk + (((t & 1023) * sk) >> 10)
+
+
 # node-state slice/scatter via one-hot over the [N] axis: a traced node
 # index would lower to a per-lane gather/scatter under vmap, which TPU
 # executes at ~10ns per element (DESIGN.md §5) — for the log-shaped leaves
@@ -308,6 +319,17 @@ def make_step(
                            ev_node)
         base_slice = _slice_node(s.node_state, h_node)
 
+        # ---- gray-failure fault plane reads (r17; DESIGN §18) ------------
+        # The acting node's clock-rate skew and disk-stall delay. Handlers
+        # observe the node's LOCAL clock (now + drift) as ctx.now — a
+        # skewed node timestamps its messages wrong, which is the whole
+        # point; its timer delays stretch inversely below, and every
+        # emission leaves disk_lat late. All exact-identity at the zero
+        # defaults, no randomness consumed.
+        sk_h = sel.take1(s.skew, h_node)
+        h_now = s.now + _drift(s.now, sk_h)
+        dlat_h = sel.take1(s.disk_lat, h_node)
+
         combos = []  # (mask, ctx) pairs; masks are mutually exclusive
         h_prog = sel.take1(node_prog_j, h_node)
         for p_idx, prog in enumerate(programs):
@@ -318,7 +340,7 @@ def make_step(
                                                    ev_payload)),
                 (is_timer, lambda c: prog.on_timer(c, ev_tag, ev_payload)),
             ):
-                ctx = Ctx(cfg, h_node, s.now, k_handler, base_slice)
+                ctx = Ctx(cfg, h_node, h_now, k_handler, base_slice)
                 run(ctx)
                 combos.append((hkind & pmask, ctx))
 
@@ -421,7 +443,9 @@ def make_step(
                 write = ok & slot_ok[j]
                 overflow = overflow | (ok & ~slot_ok[j])
                 em_write.append(write)
-                em_deadline.append(s.now + latency)
+                # slow-disk fault: a stalled node's replies leave late
+                # (dlat_h == 0 on healthy nodes — exact identity)
+                em_deadline.append(s.now + latency + dlat_h)
                 em_kind.append(jnp.asarray(T.EV_MSG, jnp.int32))
                 em_node.append(dst)
                 em_tag.append(e["tag"])
@@ -431,7 +455,14 @@ def make_step(
                 write = e["m"] & slot_ok[n_sends + j]
                 overflow = overflow | (e["m"] & ~slot_ok[n_sends + j])
                 em_write.append(write)
-                em_deadline.append(s.now + e["delay"]
+                # clock-skew stretch: a delay is measured on the node's
+                # LOCAL clock, so a fast clock (skew > 0) fires it
+                # earlier in global time — d_eff = d − (d·skew)>>10,
+                # identity at skew 0; the slow-disk delay then pushes
+                # the deadline back like every other emission
+                d_eff = jnp.maximum(e["delay"]
+                                    - _drift(e["delay"], sk_h), 0)
+                em_deadline.append(s.now + d_eff + dlat_h
                                    + jitter_draw(
                                        jit_keys[n_sends + j]
                                        if use_jitter else None))
@@ -757,16 +788,19 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     `init` handler to run on that node this step (OP_INIT / OP_RESTART —
     the NodeBuilder::init respawn of runtime/mod.rs:287-295).
     """
-    k_t, _ = prng.split(key)
+    k_t, k_tear = prng.split(key)
     N = cfg.n_nodes
 
     # resolve NODE_RANDOM targets (fuzzing): each op draws from the pool of
     # nodes it can meaningfully act on — kill/pause/clog a random alive node,
     # restart a random dead one, resume a random paused one, unclog a random
     # clogged one. A nonzero payload restricts candidates to a bitmask
-    # (31 nodes/word across ALL payload words, same packing as
-    # OP_PARTITION) so e.g. chaos kills target servers but not
-    # client/harness nodes, for any N <= 31 * payload_words.
+    # (31 nodes/word, same packing as OP_PARTITION) so e.g. chaos kills
+    # target servers but not client/harness nodes, for any
+    # N <= 31 * payload_words. Only the words node ids can actually pack
+    # into count as "a pool was given" — the r17 value-carrying ops
+    # (OP_SET_SKEW / OP_SET_DISK) put their values in the TAIL payload
+    # words, past the pool segment, so value and pool coexist.
     want_alive = (op == T.OP_KILL) | (op == T.OP_PAUSE) | (op == T.OP_CLOG_NODE)
     pool = jnp.where(want_alive, s.alive,
                      jnp.where(op == T.OP_RESTART, ~s.alive,
@@ -777,7 +811,8 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     ids = jnp.arange(N, dtype=jnp.int32)
     pool_words = sel.take1(payload, ids // 31)    # one-hot: vector-index
     in_pool = ((pool_words >> (ids % 31)) & 1) == 1     # gathers serialize
-    pool = pool & jnp.where((payload != 0).any(), in_pool,
+    n_pool_words = min(cfg.payload_words, (N + 30) // 31)   # static
+    pool = pool & jnp.where((payload[:n_pool_words] != 0).any(), in_pool,
                             jnp.ones((N,), bool))
     rnd, rnd_ok = sel.masked_choice(k_t, pool)
     is_random = node == T.NODE_RANDOM
@@ -807,13 +842,50 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
                        jnp.where(ohT & when(op == T.OP_PAUSE), True,
                                  s.paused))
 
+    # torn-write kill flush (r17, DESIGN §18): when the target runs in
+    # torn mode, a KILL first flushes a RANDOM PREFIX of each fs file's
+    # unsynced tail [dlen, mlen) into the durable view — the disk got
+    # part of the final record before power died, instead of clean
+    # old-or-new. Synced words (< dlen) are never touched, so a synced
+    # record can never tear; a cut can land mid-record, which is the
+    # point. Compiled only for fs-layer state schemas (the fs.py leaf
+    # quartet); the draw uses a key split this function already made,
+    # so enabling torn mode never shifts anyone else's PRNG stream.
+    ns = s.node_state
+    if isinstance(ns, dict) and {"fs_mem", "fs_mlen", "fs_disk",
+                                 "fs_dlen"} <= set(ns.keys()):
+        # only a LIVE node's power-fail tears: the kill half of an
+        # OP_RESTART aimed at an already-dead node is a no-op process-
+        # wise, and re-drawing a tear over the corpse's stale unsynced
+        # tail would flush words the original power-fail never did
+        tearing = kill & sel.take1(s.torn & s.alive, target)
+        mem_t = sel.take_row(ns["fs_mem"], target)      # [F, S]
+        mlen_t = sel.take_row(ns["fs_mlen"], target)    # [F]
+        disk_t = sel.take_row(ns["fs_disk"], target)
+        dlen_t = sel.take_row(ns["fs_dlen"], target)
+        F, S = mem_t.shape
+        gap = jnp.maximum(mlen_t - dlen_t, 0)
+        draw = jax.random.randint(k_tear, (F,), 0, jnp.int32(2**30),
+                                  dtype=jnp.int32)
+        cut = dlen_t + draw % (gap + 1)                 # in [dlen, mlen]
+        ws = jnp.arange(S, dtype=jnp.int32)
+        flushed = ((ws[None, :] >= dlen_t[:, None])
+                   & (ws[None, :] < cut[:, None]))
+        ns = dict(
+            ns,
+            fs_disk=sel.put_row(ns["fs_disk"], target,
+                                jnp.where(flushed, mem_t, disk_t),
+                                tearing),
+            fs_dlen=sel.put_row(ns["fs_dlen"], target,
+                                jnp.maximum(dlen_t, cut), tearing))
+
     # node boot/restart resets protocol state to the spec default — process
     # memory does not survive a crash. Leaves marked persistent are stable
     # storage (the FsSim analog) and DO survive.
     node_state = jax.tree.map(
         lambda full, dflt, keep: full if keep
         else sel.put_row(full, target, dflt, boot),
-        s.node_state, spec_default, persist_mask)
+        ns, spec_default, persist_mask)
 
     clog_node = jnp.where(ohT & when(op == T.OP_CLOG_NODE), True,
                           jnp.where(ohT & when(op == T.OP_UNCLOG_NODE),
@@ -825,11 +897,19 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
 
     # whole-matrix ops: OP_PARTITION replaces the link matrix with the cut
     # A <-> not-A (payload packs membership 31 nodes/word); OP_HEAL clears
-    # everything
+    # everything. OP_PARTITION_ONEWAY (r17) ORs a DIRECTIONAL cut into the
+    # matrix instead — src bit 0 picks the direction (0: A's sends to
+    # not-A vanish while A still hears; 1: the reverse) — so one-way cuts
+    # compose with each other and with clog_link, and only HEAL clears
+    # them (madsim disconnect2 parity).
     words = sel.take1(payload, ids // 31)     # one-hot: vector-index
     in_a = ((words >> (ids % 31)) & 1).astype(bool)       # gathers serialize
     cut = in_a[:, None] != in_a[None, :]
     clog_link = jnp.where(when(op == T.OP_PARTITION), cut, clog_link)
+    a_out = in_a[:, None] & ~in_a[None, :]          # [src, dst]: A -> not-A
+    cut_dir = jnp.where((src & 1) == 1, a_out.T, a_out)
+    clog_link = jnp.where(when(op == T.OP_PARTITION_ONEWAY),
+                          clog_link | cut_dir, clog_link)
     clog_link = jnp.where(when(op == T.OP_HEAL),
                           jnp.zeros_like(clog_link), clog_link)
     clog_node = jnp.where(when(op == T.OP_HEAL),
@@ -841,8 +921,21 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     lat_hi = jnp.where(when(op == T.OP_SET_LATENCY),
                        jnp.maximum(payload[1], payload[0]), s.lat_hi)
 
+    # gray-failure per-node knobs (r17): values ride the TAIL payload
+    # words (the leading words may hold a NODE_RANDOM pool), bounded at
+    # application — a scenario/mutant can explore, never corrupt
+    P = cfg.payload_words
+    ohSk = ohT & when(op == T.OP_SET_SKEW)
+    skew = jnp.where(ohSk, jnp.clip(payload[P - 1], -T.SKEW_CAP,
+                                    T.SKEW_CAP), s.skew)
+    ohDk = ohT & when(op == T.OP_SET_DISK)
+    disk_lat = jnp.where(ohDk, jnp.clip(payload[P - 1], 0, T.DISK_LAT_CAP),
+                         s.disk_lat)
+    torn = jnp.where(ohDk, payload[P - 2] != 0, s.torn)
+
     init_node = jnp.where(boot, target, jnp.asarray(-1, jnp.int32))
     s = s.replace(t_kind=t_kind, t_deadline=t_deadline, alive=alive,
                   paused=paused, node_state=node_state, clog_node=clog_node,
-                  clog_link=clog_link, loss=loss, lat_lo=lat_lo, lat_hi=lat_hi)
+                  clog_link=clog_link, loss=loss, lat_lo=lat_lo,
+                  lat_hi=lat_hi, skew=skew, disk_lat=disk_lat, torn=torn)
     return s, init_node, target, (kill | boot)

@@ -59,6 +59,34 @@ OP_SET_LATENCY = 12  # payload[0]=lo ticks, payload[1]=hi ticks
 OP_HEAL = 13         # clear the whole clog matrix + clogged nodes
 OP_PARTITION = 14    # payload[0] = bitmask of group A; cuts A <-> not-A both
                      # ways (single-row analog of N^2 disconnect2 calls)
+# --- gray-failure ops (r17) ------------------------------------------------
+OP_PARTITION_ONEWAY = 15  # ASYMMETRIC cut (madsim disconnect2 parity):
+                          # payload packs group A (31 nodes/word, the
+                          # OP_PARTITION packing); t_src is the direction
+                          # flag — 0 cuts A -> not-A (A's sends vanish,
+                          # A still hears), 1 cuts not-A -> A. Directional
+                          # entries are OR'd INTO the clog_link matrix
+                          # (cuts compose); OP_HEAL clears them all.
+OP_SET_SKEW = 16     # per-node clock skew: payload[LAST] = signed RATE in
+                     # 1/1024ths (clipped to ±SKEW_CAP): node's local clock
+                     # runs at (1 + skew/1024)x — observed `now` drifts and
+                     # its timer delays stretch/shrink inversely. Target may
+                     # be NODE_RANDOM with a pool in the LEADING payload
+                     # words (value and pool coexist; see _apply_super).
+OP_SET_DISK = 17     # per-node disk fault: payload[LAST] = disk latency in
+                     # ticks (every emission of the node leaves that much
+                     # later — the fsync-stall "limping node" model),
+                     # payload[LAST-1] = torn-write flag (nonzero: a KILL of
+                     # this node flushes a random PREFIX of each file's
+                     # unsynced tail to disk — a partially-written final
+                     # record instead of clean old-or-new; fs-layer models
+                     # only). Same pool/value packing as OP_SET_SKEW.
+
+# bounds enforced wherever the values enter state (supervisor op apply,
+# KnobPlan.apply): skew is a rate in 1/1024ths (±512 = ±50% clock rate),
+# disk latency is capped at 10 simulated seconds
+SKEW_CAP = 512
+DISK_LAT_CAP = 10_000_000
 
 # Node argument sentinel: draw a random target at fire time (fuzzing aid).
 # KILL/PAUSE/CLOG pick a random *alive* node; RESTART picks a random *dead* one.
@@ -398,7 +426,7 @@ class SimConfig:
         ride as operands. `emission_write` stays raw here — 'auto'
         resolves per backend at trace time, and the cache keys the
         backend separately."""
-        return ("simconfig-v4", self.n_nodes, self.event_capacity,
+        return ("simconfig-v5", self.n_nodes, self.event_capacity,
                 self.payload_words, self.table_dtype, self.emission_write,
                 bool(self.collect_stats), self.trace_cap_bucket,
                 self.sketch_slots, self.net.op_jitter_max > 0,

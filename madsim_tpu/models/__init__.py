@@ -23,4 +23,8 @@ Each model is a set of `Program` state machines plus invariants and a
   ministream        — streaming dataflow with Chandy-Lamport-style epoch
                       barriers + exactly-once commit oracle (the
                       RisingWave-shaped e2e workload)
+  percolator        — Percolator-lite transactions (primary/secondary
+                      locks, snapshot reads at local-clock timestamps,
+                      TTL lock cleanup) whose bank-sum snapshot audit is
+                      the gray-failure plane's oracle (DESIGN §18)
 """

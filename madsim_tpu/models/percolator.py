@@ -1,0 +1,402 @@
+"""Percolator-lite transactions — the gray-failure flagship (r17).
+
+A two-shard transactional KV with Percolator's shape (primary/secondary
+locks, snapshot reads, lazy commit of secondaries, TTL-based lock
+cleanup) and two LITE simplifications that make its snapshot-isolation
+invariant precisely the thing asymmetric partitions and skewed clocks
+violate:
+
+  1. **Timestamps come from each node's LOCAL clock** (`ctx.now` — which
+     the r17 skew plane drifts), not a timestamp oracle. Without skew the
+     prewrite conflict check (`write_ts >= start_ts` fails the prewrite)
+     still serializes writers per key, so the no-fault baseline is green;
+     WITH skew, cross-key timestamp inversions become reachable.
+  2. **Lock cleanup never consults the primary.** A reader that finds a
+     lock older than `ttl` (by the SERVER's local clock) rolls it back in
+     place. Real Percolator rolls FORWARD when the primary committed;
+     lite rolls back blindly — so a committed-primary transaction whose
+     secondary commit was delayed (slow disk), dropped (one-way cut), or
+     whose lock expired early (fast server clock) loses its secondary
+     write. The kept fraction of the transaction stays visible: a
+     fractured write.
+
+The oracle is bank-style total conservation under snapshot reads: every
+client audits by snapshot-reading ALL keys at one timestamp and crashes
+the trajectory (CRASH_SNAPSHOT) if the balances don't sum to the initial
+total. Two versions per key are retained; an audit whose snapshot
+predates both retained versions honestly aborts (R_RETRY) instead of
+fabricating history, so the oracle has no false positives.
+
+Durability: committed writes append to a WAL on the simulated fs
+(fs.py), synced per commit when `sync_commits=True`. Lock state is
+process memory and dies with the server — a killed server's in-flight
+transactions are aborted by client timeouts. `sync_commits=False` is the
+crash-rich configuration (group commit without the group): acked commits
+ride the page cache, so kills — and especially TORN kills, which leave a
+partially-written final record — lose or fracture committed state.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import fs
+from ..core.api import Ctx, Program
+from ..core.types import ms
+
+# message tags
+M_READ, M_READ_ACK = 1, 2
+M_PREWRITE, M_PW_ACK = 3, 4
+M_COMMIT, M_CM_ACK = 5, 6
+M_ROLLBACK = 7
+# timer tags
+T_NEW, T_TO = 1, 2
+# read statuses
+R_OK, R_LOCKED, R_RETRY = 0, 1, 2
+# client phases
+PH_IDLE, PH_READ, PH_PREWRITE, PH_COMMIT, PH_AUDIT = 0, 1, 2, 3, 4
+
+CRASH_SNAPSHOT = 501     # snapshot audit saw a fractured total
+
+N_SERVERS = 2            # shards; server_of(key) = key % 2
+LOG = 0                  # the commit WAL's fs file id
+INIT_BAL = 100
+
+
+def server_of(key):
+    return key % N_SERVERS
+
+
+def perc_state_spec(n_keys: int, log_cap: int):
+    z = jnp.asarray(0, jnp.int32)
+    K = n_keys
+    return dict(
+        **fs.fs_state(1, 3 * log_cap),
+        # server: lock column (volatile — a crashed server's locks die
+        # with it, clients abort on timeout)
+        lock_ts=jnp.zeros((K,), jnp.int32),       # 0 = unlocked
+        lock_primary=jnp.zeros((K,), jnp.int32),
+        lock_data=jnp.zeros((K,), jnp.int32),
+        lock_wall=jnp.zeros((K,), jnp.int32),     # LOCAL time when placed
+        # server: two retained versions per key (newest + previous)
+        write_ts=jnp.zeros((K,), jnp.int32),
+        write_val=jnp.full((K,), INIT_BAL, jnp.int32),
+        prev_ts=jnp.zeros((K,), jnp.int32),
+        prev_val=jnp.full((K,), INIT_BAL, jnp.int32),
+        log_n=z,
+        # client txn driver
+        c_phase=z, c_ts=z, c_cts=z, c_k1=z, c_k2=z, c_amt=z,
+        c_v1=z, c_v2=z, c_got=z, c_pw=z,
+        a_got=z, a_sum=z,
+        c_opn=z, c_done=z,
+    )
+
+
+def perc_persist_spec():
+    """Only the fs disk view survives kill/restart — the commit WAL is
+    the server's sole stable storage; locks and version caches rebuild
+    from it at boot."""
+    vol = dict(lock_ts=False, lock_primary=False, lock_data=False,
+               lock_wall=False, write_ts=False, write_val=False,
+               prev_ts=False, prev_val=False, log_n=False,
+               c_phase=False, c_ts=False, c_cts=False, c_k1=False,
+               c_k2=False, c_amt=False, c_v1=False, c_v2=False,
+               c_got=False, c_pw=False, a_got=False, a_sum=False,
+               c_opn=False, c_done=False)
+    return dict(fs.fs_persist(), **vol)
+
+
+class PercServer(Program):
+    def __init__(self, n_keys: int, log_cap: int, ttl=ms(80),
+                 sync_commits: bool = True):
+        self.K = n_keys
+        self.W = log_cap
+        self.ttl = ttl
+        self.sync_commits = sync_commits
+
+    def init(self, ctx: Ctx):
+        st = dict(ctx.state)
+        # recovery: mount the disk and replay the commit WAL in append
+        # order — write/prev columns rebuild purely from durable records
+        fs.mount(st)
+        nrec = fs.file_len(st, LOG) // 3
+        kid = jnp.arange(self.K, dtype=jnp.int32)
+        for i in range(self.W):
+            rec = fs.read_at(st, LOG, 3 * i, 3)
+            k, ts, val = rec[0], rec[1], rec[2]
+            ok = jnp.asarray(i, jnp.int32) < nrec
+            oh = (kid == jnp.clip(k, 0, self.K - 1)) & ok
+            st["prev_ts"] = jnp.where(oh, st["write_ts"], st["prev_ts"])
+            st["prev_val"] = jnp.where(oh, st["write_val"], st["prev_val"])
+            st["write_ts"] = jnp.where(oh, ts, st["write_ts"])
+            st["write_val"] = jnp.where(oh, val, st["write_val"])
+        st["log_n"] = nrec
+        ctx.state = st
+
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        K = self.K
+        local_now = ctx.now                       # the SKEWED clock
+
+        # ---- PREWRITE [start_ts, key, val, primary] ---------------------
+        is_pw = tag == M_PREWRITE
+        ts, key, val, primary = payload[0], payload[1], payload[2], payload[3]
+        kc = jnp.clip(key, 0, K - 1)
+        held_other = (st["lock_ts"][kc] != 0) & (st["lock_ts"][kc] != ts)
+        # conflict: any retained commit at/after start_ts — with local
+        # clocks this is what keeps the NO-skew baseline serializable
+        conflict = st["write_ts"][kc] >= ts
+        pw_ok = is_pw & ~held_other & ~conflict
+        fresh = pw_ok & (st["lock_ts"][kc] == 0)
+        st["lock_ts"] = st["lock_ts"].at[kc].set(
+            jnp.where(fresh, ts, st["lock_ts"][kc]))
+        st["lock_primary"] = st["lock_primary"].at[kc].set(
+            jnp.where(fresh, primary, st["lock_primary"][kc]))
+        st["lock_data"] = st["lock_data"].at[kc].set(
+            jnp.where(fresh, val, st["lock_data"][kc]))
+        st["lock_wall"] = st["lock_wall"].at[kc].set(
+            jnp.where(fresh, local_now, st["lock_wall"][kc]))
+        ctx.send(src, M_PW_ACK, [ts, key, pw_ok.astype(jnp.int32)],
+                 when=is_pw)
+
+        # ---- COMMIT [start_ts, commit_ts, key] --------------------------
+        is_cm = tag == M_COMMIT
+        cts = payload[1]
+        ck = jnp.clip(jnp.where(is_cm, payload[2], 0), 0, K - 1)
+        held = is_cm & (st["lock_ts"][ck] == ts)
+        # promote: prev <- cur, cur <- (commit_ts, locked data)
+        st["prev_ts"] = st["prev_ts"].at[ck].set(
+            jnp.where(held, st["write_ts"][ck], st["prev_ts"][ck]))
+        st["prev_val"] = st["prev_val"].at[ck].set(
+            jnp.where(held, st["write_val"][ck], st["prev_val"][ck]))
+        st["write_ts"] = st["write_ts"].at[ck].set(
+            jnp.where(held, cts, st["write_ts"][ck]))
+        st["write_val"] = st["write_val"].at[ck].set(
+            jnp.where(held, st["lock_data"][ck], st["write_val"][ck]))
+        st["lock_ts"] = st["lock_ts"].at[ck].set(
+            jnp.where(held, 0, st["lock_ts"][ck]))
+        # durable commit record (key, commit_ts, val); sync per commit
+        # unless running the group-commit crash-rich configuration
+        wrote = fs.write_all_at(
+            st, LOG, 3 * st["log_n"],
+            jnp.stack([ck, cts, st["write_val"][ck]]), when=held)
+        if self.sync_commits:
+            fs.sync_all(st, LOG, when=wrote)
+        st["log_n"] = st["log_n"] + wrote
+        cm_ok = held | (is_cm & (st["write_ts"][ck] == cts))  # idempotent
+        ctx.send(src, M_CM_ACK, [ts, payload[2], cm_ok.astype(jnp.int32)],
+                 when=is_cm)
+
+        # ---- ROLLBACK [start_ts, key] -----------------------------------
+        is_rb = tag == M_ROLLBACK
+        rk = jnp.clip(jnp.where(is_rb, payload[1], 0), 0, K - 1)
+        undo = is_rb & (st["lock_ts"][rk] == ts)
+        st["lock_ts"] = st["lock_ts"].at[rk].set(
+            jnp.where(undo, 0, st["lock_ts"][rk]))
+
+        # ---- READ [ts, key] ---------------------------------------------
+        is_rd = tag == M_READ
+        rts = payload[0]
+        dk = jnp.clip(jnp.where(is_rd, payload[1], 0), 0, K - 1)
+        blocked = is_rd & (st["lock_ts"][dk] != 0) & (st["lock_ts"][dk] <= rts)
+        # THE LITE HOLE: an expired lock (by this server's possibly-skewed
+        # local clock) is rolled back in place — no primary consult, so a
+        # committed-primary transaction's secondary write dies here
+        expired = blocked & (local_now - st["lock_wall"][dk] > self.ttl)
+        st["lock_ts"] = st["lock_ts"].at[dk].set(
+            jnp.where(expired, 0, st["lock_ts"][dk]))
+        blocked = blocked & ~expired
+        cur_vis = st["write_ts"][dk] <= rts
+        prev_vis = st["prev_ts"][dk] <= rts
+        status = jnp.where(
+            blocked, R_LOCKED,
+            jnp.where(cur_vis | prev_vis, R_OK, R_RETRY))
+        rval = jnp.where(cur_vis, st["write_val"][dk], st["prev_val"][dk])
+        ctx.send(src, M_READ_ACK, [rts, payload[1], status, rval],
+                 when=is_rd)
+        ctx.state = st
+
+
+class PercClient(Program):
+    """Alternates transfer transactions (move `amt` between two random
+    keys through the 2PC lock protocol) with snapshot AUDITS (read every
+    key at one timestamp; the balance total is the SI oracle)."""
+
+    def __init__(self, n_keys: int, n_ops: int, timeout=ms(60),
+                 think=ms(10)):
+        self.K = n_keys
+        self.O = n_ops
+        self.timeout = timeout
+        self.think = think
+        self.total = n_keys * INIT_BAL
+
+    def init(self, ctx: Ctx):
+        ctx.set_timer(ctx.randint(0, ms(20)), T_NEW, [0])
+
+    # -- txn driver --------------------------------------------------------
+    def on_timer(self, ctx: Ctx, tag, payload):
+        st = dict(ctx.state)
+        K = self.K
+        start = ((tag == T_NEW) & (st["c_phase"] == PH_IDLE)
+                 & (st["c_opn"] < self.O))
+        # timestamps are LOCAL — the lite design choice skew attacks
+        ts = ctx.now + 1
+        audit = start & (st["c_opn"] % 3 == 2)
+        xfer = start & ~audit
+        k1 = ctx.randint(0, K - 1)
+        k2 = jnp.mod(k1 + 1 + ctx.randint(0, K - 2), K)   # distinct
+        st["c_ts"] = jnp.where(start, ts, st["c_ts"])
+        st["c_k1"] = jnp.where(xfer, k1, st["c_k1"])
+        st["c_k2"] = jnp.where(xfer, k2, st["c_k2"])
+        st["c_amt"] = jnp.where(xfer, 1 + ctx.randint(0, 2), st["c_amt"])
+        st["c_got"] = jnp.where(start, 0, st["c_got"])
+        st["c_pw"] = jnp.where(start, 0, st["c_pw"])
+        st["a_got"] = jnp.where(start, 0, st["a_got"])
+        st["a_sum"] = jnp.where(start, 0, st["a_sum"])
+        st["c_phase"] = jnp.where(xfer, PH_READ,
+                                  jnp.where(audit, PH_AUDIT, st["c_phase"]))
+        ctx.send(server_of(k1), M_READ, [ts, k1], when=xfer)
+        ctx.send(server_of(k2), M_READ, [ts, k2], when=xfer)
+        for k in range(K):
+            ctx.send(server_of(k), M_READ, [ts, k], when=audit)
+        ctx.set_timer(self.timeout, T_TO, [ts], when=start)
+
+        # timeout: abort whatever is in flight. Rollbacks are best-effort
+        # (they can be lost to the same faults that caused the timeout —
+        # stuck locks are then the TTL cleanup's problem, by design)
+        to = ((tag == T_TO) & (st["c_phase"] != PH_IDLE)
+              & (payload[0] == st["c_ts"]))
+        undoing = to & ((st["c_phase"] == PH_PREWRITE)
+                        | (st["c_phase"] == PH_COMMIT))
+        ctx.send(server_of(st["c_k1"]), M_ROLLBACK,
+                 [st["c_ts"], st["c_k1"]], when=undoing)
+        ctx.send(server_of(st["c_k2"]), M_ROLLBACK,
+                 [st["c_ts"], st["c_k2"]], when=undoing)
+        self._complete(ctx, st, to)
+        ctx.state = st
+
+    def _complete(self, ctx, st, done):
+        st["c_phase"] = jnp.where(done, PH_IDLE, st["c_phase"])
+        st["c_opn"] = st["c_opn"] + done
+        st["c_done"] = jnp.where(st["c_opn"] >= self.O, 1, st["c_done"])
+        ctx.set_timer(self.think, T_NEW, [0],
+                      when=done & (st["c_opn"] < self.O))
+
+    # -- protocol replies --------------------------------------------------
+    def on_message(self, ctx: Ctx, src, tag, payload):
+        st = dict(ctx.state)
+        ts_match = payload[0] == st["c_ts"]
+
+        # READ_ACK [ts, key, status, val] — transfer read phase
+        is_rd = (tag == M_READ_ACK) & ts_match
+        rd_x = is_rd & (st["c_phase"] == PH_READ)
+        key, status, val = payload[1], payload[2], payload[3]
+        bad = status != R_OK
+        hit1 = rd_x & (key == st["c_k1"]) & ((st["c_got"] & 1) == 0)
+        hit2 = rd_x & (key == st["c_k2"]) & ((st["c_got"] & 2) == 0)
+        st["c_v1"] = jnp.where(hit1 & ~bad, val, st["c_v1"])
+        st["c_v2"] = jnp.where(hit2 & ~bad, val, st["c_v2"])
+        st["c_got"] = (st["c_got"] | jnp.where(hit1 & ~bad, 1, 0)
+                       | jnp.where(hit2 & ~bad, 2, 0))
+        # a locked/too-new key aborts the transfer (no rollback needed:
+        # nothing is locked yet)
+        self._complete(ctx, st, rd_x & bad)
+        st["c_phase"] = jnp.where(rd_x & bad, PH_IDLE, st["c_phase"])
+        both = (st["c_phase"] == PH_READ) & (st["c_got"] == 3)
+        st["c_phase"] = jnp.where(both, PH_PREWRITE, st["c_phase"])
+        # prewrite both, k1 is the primary
+        ctx.send(server_of(st["c_k1"]), M_PREWRITE,
+                 [st["c_ts"], st["c_k1"], st["c_v1"] - st["c_amt"],
+                  st["c_k1"]], when=both)
+        ctx.send(server_of(st["c_k2"]), M_PREWRITE,
+                 [st["c_ts"], st["c_k2"], st["c_v2"] + st["c_amt"],
+                  st["c_k1"]], when=both)
+
+        # READ_ACK — audit phase: accumulate the snapshot total
+        rd_a = is_rd & (st["c_phase"] == PH_AUDIT)
+        kb = 1 << jnp.clip(key, 0, 30)
+        hit_a = rd_a & ~bad & ((st["a_got"] & kb) == 0)
+        st["a_sum"] = st["a_sum"] + jnp.where(hit_a, val, 0)
+        st["a_got"] = st["a_got"] | jnp.where(hit_a, kb, 0)
+        self._complete(ctx, st, rd_a & bad)       # honest abort, no oracle
+        st["c_phase"] = jnp.where(rd_a & bad, PH_IDLE, st["c_phase"])
+        full = (1 << self.K) - 1
+        audited = (st["c_phase"] == PH_AUDIT) & (st["a_got"] == full)
+        # THE ORACLE: a complete snapshot must conserve the total
+        ctx.crash_if(audited & (st["a_sum"] != self.total), CRASH_SNAPSHOT)
+        self._complete(ctx, st, audited)
+        st["c_phase"] = jnp.where(audited, PH_IDLE, st["c_phase"])
+
+        # PW_ACK [ts, key, ok]
+        is_pw = ((tag == M_PW_ACK) & ts_match
+                 & (st["c_phase"] == PH_PREWRITE))
+        pw_fail = is_pw & (payload[2] == 0)
+        ctx.send(server_of(st["c_k1"]), M_ROLLBACK,
+                 [st["c_ts"], st["c_k1"]], when=pw_fail)
+        ctx.send(server_of(st["c_k2"]), M_ROLLBACK,
+                 [st["c_ts"], st["c_k2"]], when=pw_fail)
+        self._complete(ctx, st, pw_fail)
+        st["c_phase"] = jnp.where(pw_fail, PH_IDLE, st["c_phase"])
+        got1 = is_pw & ~pw_fail & (payload[1] == st["c_k1"])
+        got2 = is_pw & ~pw_fail & (payload[1] == st["c_k2"])
+        st["c_pw"] = (st["c_pw"] | jnp.where(got1, 1, 0)
+                      | jnp.where(got2, 2, 0))
+        locked = (st["c_phase"] == PH_PREWRITE) & (st["c_pw"] == 3)
+        st["c_phase"] = jnp.where(locked, PH_COMMIT, st["c_phase"])
+        cts = jnp.maximum(ctx.now, st["c_ts"] + 1)    # local again
+        st["c_cts"] = jnp.where(locked, cts, st["c_cts"])
+        # commit the PRIMARY first; secondaries follow lazily
+        ctx.send(server_of(st["c_k1"]), M_COMMIT,
+                 [st["c_ts"], st["c_cts"], st["c_k1"]], when=locked)
+
+        # CM_ACK [ts, key, ok] — primary outcome decides the txn
+        is_cm = ((tag == M_CM_ACK) & ts_match
+                 & (st["c_phase"] == PH_COMMIT)
+                 & (payload[1] == st["c_k1"]))
+        cm_ok = is_cm & (payload[2] != 0)
+        # LAZY secondary commit: fire-and-forget — if this message is
+        # lost (one-way cut) or outrun by the TTL (slow disk, fast
+        # server clock), the secondary lock dies by cleanup and the
+        # transaction fractures. That is the bug surface, by design.
+        ctx.send(server_of(st["c_k2"]), M_COMMIT,
+                 [st["c_ts"], st["c_cts"], st["c_k2"]], when=cm_ok)
+        # primary lock was cleaned under us: txn aborted — release k2
+        ctx.send(server_of(st["c_k2"]), M_ROLLBACK,
+                 [st["c_ts"], st["c_k2"]], when=is_cm & ~cm_ok)
+        self._complete(ctx, st, is_cm)
+        st["c_phase"] = jnp.where(is_cm, PH_IDLE, st["c_phase"])
+        ctx.cancel_timer(T_TO, when=is_cm)
+        ctx.state = st
+
+
+def clients_done(n_nodes: int):
+    def check(state):
+        return (state.node_state["c_done"][N_SERVERS:n_nodes] == 1).all()
+    return check
+
+
+def make_percolator_runtime(n_clients=3, n_ops=9, n_keys=6, ttl=ms(80),
+                            sync_commits=True, scenario=None, cfg=None):
+    """2 shard servers (nodes 0, 1; key % 2) + `n_clients` txn clients.
+    Green with no faults injected; the gray-failure recipes
+    (runtime/chaos.py) break its snapshot-isolation oracle by design."""
+    from ..core.types import NetConfig, SimConfig, sec
+    from ..runtime.runtime import Runtime
+    n = N_SERVERS + n_clients
+    # every op commits at most 2 records; margin for retries
+    log_cap = 2 * n_clients * n_ops + 8
+    if cfg is None:
+        cfg = SimConfig(n_nodes=n, event_capacity=256, payload_words=8,
+                        time_limit=sec(10),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(8)))
+    server = PercServer(n_keys, log_cap, ttl=ttl,
+                        sync_commits=sync_commits)
+    client = PercClient(n_keys, n_ops)
+    node_prog = np.asarray([0] * N_SERVERS + [1] * n_clients, np.int32)
+    return Runtime(cfg, [server, client],
+                   perc_state_spec(n_keys, log_cap),
+                   node_prog=node_prog, scenario=scenario,
+                   persist=perc_persist_spec(),
+                   halt_when=clients_done(n))

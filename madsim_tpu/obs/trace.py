@@ -44,13 +44,17 @@ def _event(now, kind, node, src, tag, **extra):
                 args=dict(src=src, tag=tag, **extra))
 
 
-def _doc(events: list[dict], node_names=None) -> dict:
+def _doc(events: list[dict], node_names=None, node_args=None) -> dict:
     # counter-track events (ph="C", obs/profiler.py) carry no tid —
-    # thread metadata names only the per-node instant/flow tracks
+    # thread metadata names only the per-node instant/flow tracks.
+    # node_args (r17) folds extra per-node facts (clock skew, disk
+    # latency) into the thread metadata args, so a gray-failure run's
+    # fault assignment reads straight off the Perfetto track list.
     tids = sorted({e["tid"] for e in events if "tid" in e})
     meta = [dict(name="thread_name", ph="M", pid=0, tid=t,
                  args=dict(name=(node_names[t] if node_names is not None
-                                 else f"node{t}")))
+                                 else f"node{t}"),
+                           **((node_args or {}).get(t, {}))))
             for t in tids]
     return dict(traceEvents=meta + events, displayTimeUnit="ms")
 
@@ -135,11 +139,24 @@ def export_chrome_trace(path: str, events=None, b: int = 0,
     """
     if (events is None) == (state is None):
         raise ValueError("pass exactly one of events= or state=")
+    node_args = None
     if state is not None:
         from .rings import ring_records
         out = to_chrome_events(ring_records(state, lane))
+        # gray-failure fault assignment (r17) on the track args: the
+        # lane's final per-node clock skew and disk latency, included
+        # only when some node actually carries a fault — a clean run's
+        # golden document is byte-identical to r16's
+        skew = np.asarray(state.skew)
+        dlat = np.asarray(state.disk_lat)
+        if skew.ndim == 2:          # batched state: this lane's view
+            skew, dlat = skew[lane], dlat[lane]
+        if skew.any() or dlat.any():
+            node_args = {n: dict(skew=int(skew[n]),
+                                 disk_lat=int(dlat[n]))
+                         for n in range(skew.shape[0])}
     else:
         out = to_chrome_events(events, b)
     with open(path, "w") as f:
-        json.dump(_doc(out, node_names), f)
+        json.dump(_doc(out, node_names, node_args), f)
     return sum(1 for e in out if e["ph"] == "i")

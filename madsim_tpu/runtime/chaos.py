@@ -48,9 +48,16 @@ def split_brain(at, group, heal_after, sc: Scenario | None = None):
 
 def flaky_network(at, loss: float, until, latency=None,
                   restore_loss: float = 0.0, restore_latency=None,
-                  sc: Scenario | None = None):
+                  heal: bool = True, sc: Scenario | None = None):
     """Degrade the network for a window: raise loss (and optionally the
-    latency range), then restore."""
+    latency range), then restore.
+
+    `heal=True` (default) also emits OP_HEAL at the window end: the
+    loss/latency scalars restore by themselves, but per-LINK state (clogs,
+    partitions, one-way cuts) composed into the same window by other
+    recipes has no scalar to restore through — without the heal a
+    composed recipe could leak cuts past its window. A heal on a
+    cut-free scenario clears nothing."""
     sc = sc or Scenario()
     sc.at(at).set_loss(loss)
     if latency is not None:
@@ -58,6 +65,8 @@ def flaky_network(at, loss: float, until, latency=None,
     sc.at(until).set_loss(restore_loss)
     if restore_latency is not None:
         sc.at(until).set_latency(*restore_latency)
+    if heal:
+        sc.at(until).heal()
     return sc
 
 
@@ -69,3 +78,92 @@ def madraft_churn(servers, rounds: int = 4, first=ms(800), period=ms(900),
     sc = rolling_kills(rounds, first, period, down, among=servers, sc=sc)
     return split_brain(partition_at, list(partition_group), heal_after,
                        sc=sc)
+
+
+# ---------------------------------------------------------------------------
+# gray-failure recipes (r17, DESIGN §18): the fault shapes madsim simulates
+# that clean kills and symmetric partitions cannot express — each is a
+# knob-plane scenario, so the fuzzer mutates its times/targets/values for
+# free (search/mutate.py fault_perturb).
+# ---------------------------------------------------------------------------
+
+def asymmetric_partition(at, group, heal_after, direction: int = 0,
+                         sc: Scenario | None = None):
+    """One-way cut for a window (madsim disconnect2 parity): direction 0
+    silences `group`'s OUTBOUND traffic while it still hears everything —
+    the classic gray failure where a node looks alive to itself (inbound
+    heartbeats arrive) but the cluster stopped hearing it. Healed at
+    window end (one-way cuts have no scalar to restore through)."""
+    sc = sc or Scenario()
+    sc.at(at).partition_oneway(group, direction=direction)
+    sc.at(at + heal_after).heal()
+    return sc
+
+
+def clock_drift(at, skew: int, node=None, among=None, until=None,
+                sc: Scenario | None = None):
+    """Skew one node's clock rate by `skew`/1024 from `at` (a random
+    pool-restricted node when `node` is None), restoring a synchronized
+    clock at `until` when given. Positive skew = fast clock: leases and
+    timeouts expire early in global time."""
+    sc = sc or Scenario()
+    if node is None:
+        sc.at(at).set_skew_random(skew, among=among)
+        if until is not None:
+            # restore over the same pool: the restore targets a random
+            # pool member too — with a 1-node pool it is exact; wider
+            # pools model operators fixing one drifting clock at a time
+            sc.at(until).set_skew_random(0, among=among)
+    else:
+        sc.at(at).set_skew(node, skew)
+        if until is not None:
+            sc.at(until).set_skew(node, 0)
+    return sc
+
+
+def slow_disk(at, latency, until, node=None, among=None,
+              sc: Scenario | None = None):
+    """Stall one node's disk for a window: every emission it makes
+    (acks, replication, its own timers) leaves `latency` ticks late —
+    the limping-but-alive node gray failure."""
+    sc = sc or Scenario()
+    if node is None:
+        sc.at(at).set_disk_random(latency, among=among)
+        sc.at(until).set_disk_random(0, among=among)
+    else:
+        sc.at(at).set_disk(node, latency)
+        sc.at(until).set_disk(node, 0)
+    return sc
+
+
+def torn_write_kill(at, node, down=ms(500), sc: Scenario | None = None):
+    """Power-fail `node` with a TORN final write: torn mode is armed one
+    tick before the kill (same-instant ops would tie-break randomly
+    against it), so the kill flushes a random prefix of each fs file's
+    unsynced tail — recovery sees a partially-written final record
+    instead of clean old-or-new. Restarts `down` later with a healthy
+    disk."""
+    sc = sc or Scenario()
+    sc.at(at - 1).set_disk(node, 0, torn=True)
+    sc.at(at).kill(node)
+    sc.at(at + down).restart(node)
+    sc.at(at + down + 1).set_disk(node, 0, torn=False)
+    return sc
+
+
+def gray_failure(at, until, group=(0,), skew: int = 256,
+                 disk_latency=ms(20), direction: int = 0,
+                 sc: Scenario | None = None):
+    """The composed gray-failure window: one-way partition the group,
+    drift the first member's clock fast, and stall its disk — then
+    restore EVERYTHING at `until`, including an OP_HEAL so the one-way
+    cuts (which have no restore scalar) cannot leak past the window."""
+    sc = sc or Scenario()
+    members = list(group)
+    sc.at(at).partition_oneway(members, direction=direction)
+    sc.at(at).set_skew(members[0], skew)
+    sc.at(at).set_disk(members[0], disk_latency)
+    sc.at(until).set_skew(members[0], 0)
+    sc.at(until).set_disk(members[0], 0)
+    sc.at(until).heal()
+    return sc

@@ -27,6 +27,12 @@ class _Row:
     node: int = 0
     src: int = 0
     payload: tuple = ()
+    # value words written RIGHT-ALIGNED into the payload (tail[-1] lands
+    # at payload_words-1): the r17 value-carrying ops (OP_SET_SKEW /
+    # OP_SET_DISK) keep their values past the pool segment so a
+    # NODE_RANDOM pool and a value coexist in one row (step.py
+    # _apply_super reads values from the tail, pools from the head)
+    payload_tail: tuple = ()
 
 
 class Scenario:
@@ -60,6 +66,8 @@ class Scenario:
         T.OP_CLOG_LINK: "clog_link", T.OP_UNCLOG_LINK: "unclog_link",
         T.OP_SET_LOSS: "set_loss", T.OP_SET_LATENCY: "set_latency",
         T.OP_HEAL: "heal", T.OP_PARTITION: "partition", T.OP_HALT: "halt",
+        T.OP_PARTITION_ONEWAY: "partition_oneway",
+        T.OP_SET_SKEW: "set_skew", T.OP_SET_DISK: "set_disk",
     }
 
     @staticmethod
@@ -73,10 +81,19 @@ class Scenario:
         tick times, decoded pools/partitions/rates — a script re-entered
         from this text reproduces the original fault model."""
         out = []
+        # the r17 value-carrying ops keep how many TAIL payload words?
+        # (builder rows carry them in payload_tail; KnobPlan.to_scenario
+        # rows bake them into the payload's end — the pool decode below
+        # must not read value bits as phantom pool members)
+        n_tail = {T.OP_SET_SKEW: 1, T.OP_SET_DISK: 2}
         for r in self.rows:
             name = self._OP_NAMES.get(r.op, f"op{r.op}")
             if r.node == T.NODE_RANDOM:
-                pool = self._unpack_members(r.payload)
+                pool_words = r.payload
+                k = n_tail.get(r.op, 0)
+                if k and not r.payload_tail:
+                    pool_words = r.payload[:-k]
+                pool = self._unpack_members(pool_words)
                 tgt = (f"random among {pool}" if pool else "random")
             else:
                 tgt = f"node {r.node}"
@@ -87,6 +104,18 @@ class Scenario:
             elif r.op == T.OP_PARTITION:
                 tgt = ""
                 extra = f" group_a={self._unpack_members(r.payload)}"
+            elif r.op == T.OP_PARTITION_ONEWAY:
+                tgt = ""
+                extra = (f" group_a={self._unpack_members(r.payload)}"
+                         f" dir={'in' if r.src & 1 else 'out'}")
+            elif r.op in (T.OP_SET_SKEW, T.OP_SET_DISK):
+                # builder rows keep values in payload_tail; rows round-
+                # tripped through KnobPlan.to_scenario carry the full
+                # payload with the values already right-aligned — the
+                # tail IS the payload's tail either way
+                vals = [0, 0] + list(r.payload_tail or r.payload)
+                extra = (f" skew={vals[-1]}" if r.op == T.OP_SET_SKEW
+                         else f" lat={vals[-1]}us torn={vals[-2]}")
             elif r.op == T.OP_SET_LOSS:
                 tgt = ""
                 extra = f" rate={r.payload[0] / 1e6:g}"
@@ -94,11 +123,106 @@ class Scenario:
                 tgt = ""
                 extra = (f" latency={r.payload[0]}us"
                          f"..{r.payload[1]}us")
-            elif r.op == T.OP_HALT:
+            elif r.op in (T.OP_HALT, T.OP_HEAL):
                 tgt = ""
             out.append(f"  t={r.time}us {name}"
                        f"{' ' + tgt if tgt else ''}{extra}")
         return "\n".join(out)
+
+    @classmethod
+    def parse(cls, text: str) -> "Scenario":
+        """Inverse of `describe()` — the script RE-ENTRY contract: a
+        describe()d script parses back into a Scenario whose `build()`
+        encodes the identical rows (tests/test_grayfail.py round-trips
+        every op in the decode table). Covers the built-in op table;
+        extension custom ops (`opN` lines) are rejected — their payload
+        encoding is the extension's, not the scenario grammar's."""
+        import re
+        by_name = {v: k for k, v in cls._OP_NAMES.items()}
+        sc = cls()
+        for raw in text.splitlines():
+            line = raw.strip()
+            if not line:
+                continue
+            m = re.match(r"t=(\d+)us (\w+)\s*(.*)$", line)
+            if not m:
+                raise ValueError(f"unparseable scenario line: {raw!r}")
+            t, name, rest = int(m.group(1)), m.group(2), m.group(3)
+            if name not in by_name:
+                raise ValueError(
+                    f"unknown scenario op {name!r} (extension custom ops "
+                    f"don't round-trip through describe/parse): {raw!r}")
+            op = by_name[name]
+            at = sc.at(t)
+
+            def target(rest):
+                """(node, pool, rest) from a leading target clause."""
+                mm = re.match(r"node (\d+)\s*(.*)$", rest)
+                if mm:
+                    return int(mm.group(1)), None, mm.group(2)
+                mm = re.match(r"random among \[([\d,\s]*)\]\s*(.*)$", rest)
+                if mm:
+                    pool = [int(x) for x in mm.group(1).split(",") if
+                            x.strip()]
+                    return T.NODE_RANDOM, pool, mm.group(2)
+                mm = re.match(r"random\s*(.*)$", rest)
+                if mm:
+                    return T.NODE_RANDOM, None, mm.group(1)
+                raise ValueError(f"unparseable target in: {raw!r}")
+
+            if op in (T.OP_CLOG_LINK, T.OP_UNCLOG_LINK):
+                mm = re.match(r"(\d+)->(\d+)$", rest)
+                s_, d = int(mm.group(1)), int(mm.group(2))
+                (at.clog_link if op == T.OP_CLOG_LINK
+                 else at.unclog_link)(s_, d)
+            elif op == T.OP_PARTITION:
+                mm = re.match(r"group_a=\[([\d,\s]*)\]$", rest)
+                at.partition([int(x) for x in mm.group(1).split(",")
+                              if x.strip()])
+            elif op == T.OP_PARTITION_ONEWAY:
+                mm = re.match(r"group_a=\[([\d,\s]*)\] dir=(out|in)$", rest)
+                at.partition_oneway(
+                    [int(x) for x in mm.group(1).split(",") if x.strip()],
+                    direction=1 if mm.group(2) == "in" else 0)
+            elif op == T.OP_SET_LOSS:
+                at.set_loss(round(float(rest.split("=")[1]) * 1e6) / 1e6)
+            elif op == T.OP_SET_LATENCY:
+                mm = re.match(r"latency=(\d+)us\.\.(\d+)us$", rest)
+                at.set_latency(int(mm.group(1)), int(mm.group(2)))
+            elif op == T.OP_HALT:
+                at.halt()
+            elif op == T.OP_HEAL:
+                at.heal()
+            elif op == T.OP_SET_SKEW:
+                node, pool, rest = target(rest)
+                v = int(re.match(r"skew=(-?\d+)$", rest).group(1))
+                if node == T.NODE_RANDOM:
+                    at.set_skew_random(v, among=pool)
+                else:
+                    at.set_skew(node, v)
+            elif op == T.OP_SET_DISK:
+                node, pool, rest = target(rest)
+                mm = re.match(r"lat=(\d+)us torn=(\d+)$", rest)
+                lat, torn = int(mm.group(1)), bool(int(mm.group(2)))
+                if node == T.NODE_RANDOM:
+                    at.set_disk_random(lat, torn=torn, among=pool)
+                else:
+                    at.set_disk(node, lat, torn=torn)
+            else:               # node-lifecycle / clog ops
+                node, pool, _ = target(rest)
+                method = {
+                    T.OP_INIT: "boot", T.OP_KILL: "kill",
+                    T.OP_RESTART: "restart", T.OP_PAUSE: "pause",
+                    T.OP_RESUME: "resume", T.OP_CLOG_NODE: "clog_node",
+                    T.OP_UNCLOG_NODE: "unclog_node"}[op]
+                if node == T.NODE_RANDOM:
+                    # re-enter the exact encoding the builders produce:
+                    # NODE_RANDOM target + the 31-nodes/word pool words
+                    at._add(op, T.NODE_RANDOM,
+                            payload=_At._pool(pool) if pool else ())
+                else:
+                    getattr(at, method)(node)
+        return sc
 
     def build(self, cfg: T.SimConfig):
         """-> dict of numpy arrays (time, op, node, src, payload[R, P])."""
@@ -109,18 +233,36 @@ class Scenario:
             node=np.zeros(R, np.int32), src=np.zeros(R, np.int32),
             payload=np.zeros((R, P), np.int32),
         )
+        n_pool_words = min(P, (cfg.n_nodes + 30) // 31)
         for i, r in enumerate(self.rows):
-            if len(r.payload) > P:
+            if len(r.payload) + len(r.payload_tail) > P:
                 raise ValueError(
                     f"scenario op {r.op} at t={r.time} needs "
-                    f"{len(r.payload)} payload words but cfg.payload_words="
-                    f"{P} (partition masks pack 31 nodes per word)")
+                    f"{len(r.payload)}+{len(r.payload_tail)} payload words "
+                    f"but cfg.payload_words={P} (pools pack 31 nodes per "
+                    f"word; set_skew/set_disk values ride the tail words)")
+            if (r.payload_tail and r.node == T.NODE_RANDOM
+                    and P - len(r.payload_tail) < n_pool_words):
+                # a value word landing INSIDE the pool segment would be
+                # bit-decoded as phantom pool members by the NODE_RANDOM
+                # resolution (step.py reads pools from the first
+                # ceil(N/31) words) — refuse instead of mistargeting
+                raise ValueError(
+                    f"scenario op {r.op} at t={r.time}: its "
+                    f"{len(r.payload_tail)} value word(s) overlap the "
+                    f"{n_pool_words}-word NODE_RANDOM pool segment — "
+                    f"raise cfg.payload_words past "
+                    f"{n_pool_words + len(r.payload_tail)}")
             out["time"][i] = r.time
             out["op"][i] = r.op
             out["node"][i] = r.node
             out["src"][i] = r.src
             for j, w in enumerate(r.payload):
                 out["payload"][i, j] = w
+            # value words land right-aligned (tail[-1] at P-1), where
+            # step.py _apply_super reads them past any pool segment
+            for j, w in enumerate(r.payload_tail):
+                out["payload"][i, P - len(r.payload_tail) + j] = w
         return out
 
 
@@ -128,9 +270,9 @@ class _At:
     def __init__(self, sc: Scenario, time: int):
         self._sc, self._t = sc, time
 
-    def _add(self, op, node=0, src=0, payload=()):
+    def _add(self, op, node=0, src=0, payload=(), payload_tail=()):
         self._sc.rows.append(_Row(self._t, op, int(node), int(src),
-                                  tuple(payload)))
+                                  tuple(payload), tuple(payload_tail)))
         return self
 
     # -- node lifecycle (Handle::kill/restart/pause/resume) ----------------
@@ -211,8 +353,58 @@ class _At:
             words[n // 31] |= 1 << (n % 31)
         return self._add(T.OP_PARTITION, payload=tuple(words))
 
+    def partition_oneway(self, group_a, direction: int = 0):
+        """ASYMMETRIC cut (madsim `disconnect2` parity, r17): direction 0
+        cuts A -> not-A — group_a's sends to the outside vanish while
+        everything the outside sends A still arrives; direction 1 cuts the
+        reverse. Directional entries are OR'd INTO the clog_link matrix,
+        so one-way cuts compose (two opposite one-way cuts == a full
+        partition); only `heal()` clears them. Membership packs 31 nodes
+        per payload word, like `partition()`."""
+        words = [0] * (1 + max((int(n) for n in group_a), default=0) // 31)
+        for n in group_a:
+            n = int(n)
+            words[n // 31] |= 1 << (n % 31)
+        return self._add(T.OP_PARTITION_ONEWAY, src=int(direction) & 1,
+                         payload=tuple(words))
+
+    def set_skew(self, node, skew: int):
+        """Set `node`'s clock-RATE skew in 1/1024ths (r17): its local
+        clock runs at (1 + skew/1024)x — handlers observe the drifted
+        `ctx.now` and the node's timer delays stretch/shrink inversely,
+        so a fast clock expires leases/timeouts early in global time.
+        Clipped to ±SKEW_CAP (±50%) at application; 0 restores a
+        synchronized clock."""
+        return self._add(T.OP_SET_SKEW, node,
+                         payload_tail=(int(skew),))
+
+    def set_skew_random(self, skew: int, among=None):
+        """Skew a random node's clock (pool-restricted like
+        kill_random); the value rides the tail payload word, so pool and
+        value coexist."""
+        return self._add(T.OP_SET_SKEW, T.NODE_RANDOM,
+                         payload=self._pool(among),
+                         payload_tail=(int(skew),))
+
+    def set_disk(self, node, latency: int = 0, torn: bool = False):
+        """Set `node`'s disk fault state (r17): `latency` ticks are added
+        to every emission the node makes (the fsync-stalled event loop —
+        replies and timers leave late), and `torn=True` arms torn-write-
+        on-kill mode (a kill flushes a random prefix of each fs file's
+        unsynced tail to disk, so recovery can see a partially-written
+        final record). `set_disk(n)` restores a healthy disk."""
+        return self._add(T.OP_SET_DISK, node,
+                         payload_tail=(int(bool(torn)), int(latency)))
+
+    def set_disk_random(self, latency: int = 0, torn: bool = False,
+                        among=None):
+        """Disk-fault a random node (pool-restricted like kill_random)."""
+        return self._add(T.OP_SET_DISK, T.NODE_RANDOM,
+                         payload=self._pool(among),
+                         payload_tail=(int(bool(torn)), int(latency)))
+
     def heal(self):
-        """Clear all clogs/partitions."""
+        """Clear all clogs/partitions (one-way cuts included)."""
         return self._add(T.OP_HEAL)
 
     def set_loss(self, rate: float):

@@ -44,14 +44,21 @@ from ..ops import select as sel
 
 # mutation operator ids (the op histogram in fuzz results uses this order)
 OP_NAMES = ("time_nudge", "target_reshuffle", "row_toggle", "row_dup",
-            "latency_perturb", "loss_perturb", "prio_perturb")
+            "latency_perturb", "loss_perturb", "prio_perturb",
+            "fault_perturb")
 N_MUT_OPS = len(OP_NAMES)
 
 # ops whose node target is meaningful and pool-restricted (step.py
 # _apply_super: the random-target pool packing); everything else keeps its
-# base node
+# base node. The r17 per-node fault ops ride along: the fuzzer may move
+# WHICH node's clock drifts or disk stalls, pool-confined like kills.
 _NODE_OPS = (T.OP_KILL, T.OP_RESTART, T.OP_PAUSE, T.OP_RESUME,
-             T.OP_CLOG_NODE, T.OP_UNCLOG_NODE)
+             T.OP_CLOG_NODE, T.OP_UNCLOG_NODE,
+             T.OP_SET_SKEW, T.OP_SET_DISK)
+# r17 gray-failure value/flag knobs: rows whose TAIL payload word carries
+# a bounded value (skew rate / disk latency), whose payload[-2] carries
+# the torn flag, and whose src carries the one-way-cut direction
+_VAL_OPS = (T.OP_SET_SKEW, T.OP_SET_DISK)
 # rows that must never move, drop, or duplicate: HALT carries the
 # time-limit contract, INIT rows interact with the template's deferred-boot
 # bookkeeping (runtime.py _build_template)
@@ -82,6 +89,14 @@ class KnobPlan:
     drop_ok: np.ndarray         # bool[R]
     pool_ok: np.ndarray         # bool[R, N+1]: pool_ok[r, t+1] — target t
                                 # allowed for row r (t = -1 always allowed)
+    # r17 gray-failure knob guards: which rows carry a mutable tail VALUE
+    # (skew rate / disk latency — bounds per row, enforced at apply),
+    # a one-way-cut DIRECTION flag (src), or a TORN flag (payload[-2])
+    val_ok: np.ndarray          # bool[R]
+    val_lo: np.ndarray          # int32[R] — value bound, 0 on non-val rows
+    val_hi: np.ndarray          # int32[R]
+    dir_ok: np.ndarray          # bool[R]
+    torn_ok: np.ndarray         # bool[R]
     net0: tuple                 # (loss, lat_lo, lat_hi, jitter) base scalars
 
     @staticmethod
@@ -100,8 +115,12 @@ class KnobPlan:
         N = cfg.n_nodes
         pool_ok = np.zeros((R, N + 1), bool)
         pool_ok[:, 0] = True                       # NODE_RANDOM always legal
+        # only the words node ids can pack into count as "a pool was
+        # given" — the r17 value-carrying ops keep their values in the
+        # TAIL payload words (step.py _apply_super applies the same rule)
+        n_pool_words = min(cfg.payload_words, (N + 30) // 31)
         for r in range(R):
-            pay = rows["payload"][r]
+            pay = rows["payload"][r][:n_pool_words]
             if node_ok[r] and pay.any():
                 # pool-restricted random target (31 nodes/word packing):
                 # reshuffles must stay inside the pool — the in-bounds
@@ -111,6 +130,14 @@ class KnobPlan:
                         (int(pay[t // 31]) >> (t % 31)) & 1)
             else:
                 pool_ok[r, 1:] = True
+        # r17 fault knobs: per-row value bounds (skew is a ±rate, disk
+        # latency a nonnegative tick count), flag carriers
+        val_ok = np.isin(op, _VAL_OPS)
+        dir_ok = op == T.OP_PARTITION_ONEWAY
+        torn_ok = (op == T.OP_SET_DISK) & (cfg.payload_words >= 2)
+        val_lo = np.where(op == T.OP_SET_SKEW, -T.SKEW_CAP, 0)
+        val_hi = np.where(op == T.OP_SET_SKEW, T.SKEW_CAP,
+                          np.where(op == T.OP_SET_DISK, T.DISK_LAT_CAP, 0))
         return KnobPlan(
             n_init=n_init, R=R, D=D, N=N, payload_words=cfg.payload_words,
             jitter_gate=cfg.net.op_jitter_max > 0,
@@ -121,6 +148,8 @@ class KnobPlan:
                       payload=rows["payload"].astype(np.int32)),
             time_ok=~pinned, node_ok=node_ok, drop_ok=~pinned,
             pool_ok=pool_ok,
+            val_ok=val_ok, val_lo=val_lo.astype(np.int32),
+            val_hi=val_hi.astype(np.int32), dir_ok=dir_ok, torn_ok=torn_ok,
             net0=(float(cfg.net.packet_loss_rate),
                   int(cfg.net.send_latency_min),
                   int(cfg.net.send_latency_max),
@@ -131,10 +160,21 @@ class KnobPlan:
         """The unmutated knob vector: exactly the Runtime's own scenario
         and NetConfig (applying it is a no-op modulo slot bookkeeping)."""
         loss, lo, hi, jit = self.net0
+        P = self.payload_words
+        pay = self.base["payload"]
+        # r17 fault knobs, read back from where build() encoded them:
+        # value = tail word P-1 (skew rate / disk latency), flag = the
+        # one-way direction (src bit 0) or the torn flag (word P-2)
+        row_val = np.where(self.val_ok, pay[:, P - 1], 0).astype(np.int32)
+        row_flag = np.where(
+            self.dir_ok, self.base["src"] & 1,
+            np.where(self.torn_ok, pay[:, P - 2] if P >= 2
+                     else np.zeros(self.R, np.int32), 0)).astype(np.int32)
         return dict(
             row_time=self.base["time"].copy(),
             row_node=self.base["node"].copy(),
             row_on=np.ones(self.R, bool),
+            row_val=row_val, row_flag=row_flag,
             dup_src=np.zeros(self.D, np.int32),
             dup_time=np.full(self.D, T.T_INF, np.int32),
             dup_on=np.zeros(self.D, bool),
@@ -158,7 +198,12 @@ class KnobPlan:
         return dict(time_ok=jnp.asarray(self.time_ok),
                     node_ok=jnp.asarray(self.node_ok),
                     drop_ok=jnp.asarray(self.drop_ok),
-                    pool_ok=jnp.asarray(self.pool_ok))
+                    pool_ok=jnp.asarray(self.pool_ok),
+                    val_ok=jnp.asarray(self.val_ok),
+                    val_lo=jnp.asarray(self.val_lo),
+                    val_hi=jnp.asarray(self.val_hi),
+                    dir_ok=jnp.asarray(self.dir_ok),
+                    torn_ok=jnp.asarray(self.torn_ok))
 
     # -- the two jitted kernels -------------------------------------------
     def mutate(self, knobs_batch, key, havoc: int = 3):
@@ -218,6 +263,23 @@ class KnobPlan:
         from ..runtime.scenario import Scenario, _Row
         sc = Scenario()
         kn = {k: np.asarray(v) for k, v in knobs.items()}
+
+        def row_src_pay(r):
+            """The row's src/payload with the r17 fault knobs rendered
+            in (same bounds as apply); values ride the full payload —
+            describe() falls back to it when payload_tail is absent."""
+            src = int(self.base["src"][r])
+            pay = [int(w) for w in self.base["payload"][r]]
+            P = self.payload_words
+            if self.val_ok[r]:
+                pay[P - 1] = int(np.clip(kn["row_val"][r],
+                                         self.val_lo[r], self.val_hi[r]))
+            if self.torn_ok[r]:
+                pay[P - 2] = int(kn["row_flag"][r]) & 1
+            if self.dir_ok[r]:
+                src = int(kn["row_flag"][r]) & 1
+            return src, tuple(pay)
+
         for r in range(self.R):
             on = bool(kn["row_on"][r]) or not self.drop_ok[r]
             if not on:
@@ -226,10 +288,8 @@ class KnobPlan:
                  else int(self.base["time"][r]))
             node = (int(kn["row_node"][r]) if self.node_ok[r]
                     else int(self.base["node"][r]))
-            sc.rows.append(_Row(t, int(self.base["op"][r]), node,
-                                int(self.base["src"][r]),
-                                tuple(int(w) for w in
-                                      self.base["payload"][r])))
+            src, pay = row_src_pay(r)
+            sc.rows.append(_Row(t, int(self.base["op"][r]), node, src, pay))
         for d in range(self.D):
             if not bool(kn["dup_on"][d]):
                 continue
@@ -238,11 +298,9 @@ class KnobPlan:
                 continue
             node = (int(kn["row_node"][srow]) if self.node_ok[srow]
                     else int(self.base["node"][srow]))
+            src, pay = row_src_pay(srow)
             sc.rows.append(_Row(int(kn["dup_time"][d]),
-                                int(self.base["op"][srow]), node,
-                                int(self.base["src"][srow]),
-                                tuple(int(w) for w in
-                                      self.base["payload"][srow])))
+                                int(self.base["op"][srow]), node, src, pay))
         sc.rows.sort(key=lambda r: r.time)
         return sc
 
@@ -282,7 +340,7 @@ def _mutate_one(kn, key, g, havoc):
     hist = jnp.zeros((N_MUT_OPS,), jnp.int32)
     last_op = jnp.asarray(-1, jnp.int32)
     for k in prng.split(key, havoc):
-        ks = prng.split(k, 12)
+        ks = prng.split(k, 16)
         op = prng.randint(ks[0], 0, N_MUT_OPS - 1)
 
         # 0: time nudge — multi-scale delta on one mutable row
@@ -357,7 +415,30 @@ def _mutate_one(kn, key, g, havoc):
                                   dtype=jnp.int32)
         prio = jnp.where(op == 6, bits, kn["prio_nudge"])
 
+        # 7: fault perturbation (r17) — pick a gray-failure row and
+        # either nudge its bounded VALUE (skew rate / disk latency;
+        # delta scales with the row's own bound span, clip at apply
+        # re-enforces it) or toggle its FLAG (one-way direction /
+        # torn mode). Guard-aware: value-only rows never get a flag
+        # toggle and vice versa.
+        fault_ok = g["val_ok"] | g["dir_ok"] | g["torn_ok"]
+        r_f, ok_f = sel.masked_choice(ks[12], fault_ok)
+        has_flag = sel.take1(g["dir_ok"] | g["torn_ok"], r_f)
+        has_val = sel.take1(g["val_ok"], r_f)
+        want_flag = prng.bernoulli(ks[13], 0.35)
+        do_flag = has_flag & (want_flag | ~has_val)
+        oh_f = sel.row_onehot(R, r_f) & (op == 7) & ok_f
+        span = g["val_hi"] - g["val_lo"]
+        vdelta = (prng.randint(ks[14], -8, 8)
+                  * jnp.maximum(span // 64, 1))
+        row_val = jnp.clip(
+            kn["row_val"] + jnp.where(oh_f & ~do_flag, vdelta, 0),
+            g["val_lo"], g["val_hi"])
+        row_flag = jnp.where(oh_f & do_flag, kn["row_flag"] ^ 1,
+                             kn["row_flag"])
+
         kn = dict(row_time=row_time, row_node=row_node, row_on=row_on,
+                  row_val=row_val, row_flag=row_flag,
                   dup_src=dup_src, dup_time=dup_time, dup_on=dup_on,
                   loss=loss, lat_lo=lat_lo, lat_hi=lat_hi, jitter=jitter,
                   prio_nudge=prio)
@@ -366,7 +447,8 @@ def _mutate_one(kn, key, g, havoc):
         # histogram feeds fuzz()'s `mutation_ops` / --search-smoke's
         # "operators used" gate
         applied = (((op == 0) & ok_t) | ((op == 1) & ok_n)
-                   | ((op == 2) & ok_d) | ((op == 3) & dup_eff) | (op >= 4))
+                   | ((op == 2) & ok_d) | ((op == 3) & dup_eff)
+                   | ((op >= 4) & (op <= 6)) | ((op == 7) & ok_f))
         hist = hist + ((jnp.arange(N_MUT_OPS, dtype=jnp.int32) == op)
                        & applied).astype(jnp.int32)
         # the lane's LAST applied operator: the coverage-yield
@@ -431,13 +513,31 @@ def _apply_batch(state, knobs, base, guards, n_init, jitter_gate):
         in_pool = (guards["pool_ok"] & oh_pool).any(axis=1)
         row_node = jnp.where(guards["node_ok"] & ~in_pool,
                              jnp.asarray(T.NODE_RANDOM, jnp.int32), row_node)
+        # r17 fault knobs, bounds enforced HERE like everything else:
+        # values clip to the row's own [lo, hi] (skew stays a ±rate,
+        # disk latency nonnegative), flags collapse to one bit; a
+        # hand-edited vector can explore, never corrupt. Values land in
+        # the TAIL payload words (P-1 value, P-2 torn), the direction
+        # in src bit 0 — the encoding _apply_super reads.
+        P = base["payload"].shape[1]
+        row_val = jnp.clip(kn["row_val"], guards["val_lo"],
+                           guards["val_hi"])
+        row_pay = base["payload"].astype(jnp.int32)
+        row_pay = row_pay.at[:, P - 1].set(
+            jnp.where(guards["val_ok"], row_val, row_pay[:, P - 1]))
+        if P >= 2:
+            row_pay = row_pay.at[:, P - 2].set(
+                jnp.where(guards["torn_ok"], kn["row_flag"] & 1,
+                          row_pay[:, P - 2]))
+        row_src = jnp.where(guards["dir_ok"], kn["row_flag"] & 1,
+                            base["src"])
         seg_deadline = [jnp.where(row_on, row_time,
                                   jnp.asarray(T.T_INF, jnp.int32))]
         seg_kind = [jnp.where(row_on, T.EV_SUPER, T.EV_FREE)]
         seg_node = [row_node]
-        seg_src = [base["src"]]
+        seg_src = [row_src]
         seg_tag = [base["op"]]
-        seg_payload = [base["payload"]]
+        seg_payload = [row_pay]
         if D > 0:
             dsrc = jnp.clip(kn["dup_src"], 0, R - 1)
             d_ok = kn["dup_on"] & sel.take1(guards["drop_ok"], dsrc)
@@ -446,9 +546,9 @@ def _apply_batch(state, knobs, base, guards, n_init, jitter_gate):
                 jnp.asarray(T.T_INF, jnp.int32)))
             seg_kind.append(jnp.where(d_ok, T.EV_SUPER, T.EV_FREE))
             seg_node.append(sel.take1(row_node, dsrc))
-            seg_src.append(sel.take1(base["src"], dsrc))
+            seg_src.append(sel.take1(row_src, dsrc))
             seg_tag.append(sel.take1(base["op"], dsrc))
-            seg_payload.append(_take_rows(base["payload"], dsrc))
+            seg_payload.append(_take_rows(row_pay, dsrc))
         lo = n_init
         hi = n_init + R + D
 

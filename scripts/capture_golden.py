@@ -1,0 +1,21 @@
+"""Freeze per-leaf golden digests for the r17 equivalence contract.
+
+Run this ONLY at an engine state whose trajectories are the truth being
+gated (it was run at r16 HEAD before the gray-failure plane landed).
+Re-running it after an engine change would overwrite the evidence with
+whatever the current tree produces — the test would then prove nothing.
+
+    JAX_PLATFORMS=cpu python scripts/capture_golden.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import _grayfail_golden as g  # noqa: E402
+
+doc = g.capture()
+n = sum(len(v) for w in doc.values() for v in w.values())
+print(f"captured {n} leaf digests -> {g.GOLDEN_PATH}")

@@ -73,6 +73,17 @@ case "$tier" in
     # CRASH_SLO and replay by seed, and the Perfetto export must carry
     # the rolling per-node e2e-p99 track
     python bench.py --lat-smoke
+    # gray-failure smoke: a one-way cut must be observed asymmetrically
+    # by gossip, skewed lease expiry on the Percolator-lite flagship
+    # must crash the snapshot oracle and reproduce on seed replay, and
+    # a torn-write fuzz campaign must open causal-fingerprint crash
+    # buckets with replayable (seed, knobs) handles
+    python bench.py --grayfail-smoke
+    # regression gate (OSS-Fuzz-style): every committed crash bucket in
+    # tests/data/regression_corpus must still reproduce (run-twice
+    # verified) and the top-energy corpus slice must still land on its
+    # recorded schedule hashes
+    python bench.py --regression-smoke
     # DetSan smoke: the repo-wide determinism lint gate must be clean,
     # a seeded schedule race must confirm via the forced-commute PCT
     # nudge with a replayable (seed, knobs, nudge) repro and dedupe

@@ -1,0 +1,50 @@
+"""(Re)generate the committed regression corpus (tests/data/
+regression_corpus/) — the OSS-Fuzz-style gate `bench.py
+--regression-smoke` replays in ci.sh fast.
+
+Runs a small DETERMINISTIC durable fuzz campaign on the gray-failure
+flagship and freezes the resulting corpus dir (entries + causal-
+fingerprint crash buckets + worker state) plus a REGRESSION.json
+sidecar naming the runtime factory and replay budget. Re-run this ONLY
+when the store signature legitimately moves (a new knob dimension, a
+structural change to the flagship) — the whole point of the gate is
+that buckets keep reproducing across unrelated changes.
+
+    JAX_PLATFORMS=cpu python scripts/make_regression_corpus.py
+"""
+
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+from madsim_tpu import fuzz  # noqa: E402
+from madsim_tpu.service.store import CorpusStore  # noqa: E402
+
+DEST = os.path.join(REPO, "tests", "data", "regression_corpus",
+                    "grayfail_mix")
+MAX_STEPS = 30_000
+
+shutil.rmtree(DEST, ignore_errors=True)
+rt = bench._make_grayfail_runtime("mix")
+res = fuzz(rt, max_steps=MAX_STEPS, batch=64, max_rounds=4, dry_rounds=5,
+           chunk=512, corpus_dir=DEST, rng_seed=1)
+store = CorpusStore(DEST, create=False)
+keys = store.bucket_keys()
+assert keys, "campaign found no crash buckets — nothing to gate on"
+with open(os.path.join(DEST, "REGRESSION.json"), "w") as f:
+    json.dump(dict(
+        factory="bench:_make_grayfail_runtime",
+        factory_kwargs=dict(recipe="mix"),
+        dup_slots=2,
+        max_steps=MAX_STEPS,
+        buckets=keys,
+        note=("frozen by scripts/make_regression_corpus.py; replayed "
+              "by bench.py --regression-smoke in ci.sh fast"),
+    ), f, indent=1)
+print(f"{DEST}: {len(store.entry_names())} entries, "
+      f"{len(keys)} buckets: {keys}")
