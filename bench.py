@@ -1141,6 +1141,122 @@ def _grayfail_smoke_mode():
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
+def _triage_smoke_mode():
+    """--triage-smoke: seconds-scale campaign-triage-plane self-test
+    for CI (scripts/ci.sh fast):
+
+      1. a short 2-worker campaign on the torn-write recipe runs into
+         one corpus dir (workers write triage/ROWS.json on open);
+      2. snapshot twice — byte-identical bodies, self-diff EMPTY;
+      3. mutate the store (open exactly one planted bucket), snapshot
+         again — the diff reports EXACTLY that bucket as `new`, with
+         the torn_write recipe attribution its knob vector encodes,
+         and both attribution dimensions still sum to their totals;
+      4. render the standing HTML dashboard (structure asserted) and
+         the `service.report --against prev` terminal diff;
+      5. audit one bucket through replay_bucket(verify=True) — the
+         repro-health ledger records a verdict without aborting.
+    """
+    _force_cpu_inprocess()
+    import shutil
+    import subprocess
+    import tempfile
+    from madsim_tpu import KnobPlan
+    from madsim_tpu.obs.causal import causal_fingerprint
+    from madsim_tpu.obs.dashboard import render_html
+    from madsim_tpu.runtime.scenario import RECIPE_FAMILIES
+    from madsim_tpu.service import (CorpusStore, CrashBuckets,
+                                    audit_buckets, run_campaign,
+                                    triage_diff, triage_snapshot)
+    from madsim_tpu.service.triage import snapshot_path
+    t0 = time.perf_counter()
+    factory = "bench:_make_grayfail_runtime"
+    fkw = dict(recipe="torn")           # shares executables with
+    steps = 40_000                      # --grayfail-smoke's campaign
+    kw = dict(max_steps=steps, batch=64, max_rounds=2, chunk=512)
+    root = tempfile.mkdtemp(prefix="madsim_triage_smoke_")
+    env = _cpu_env()
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    try:
+        d = os.path.join(root, "campaign")
+        rep = run_campaign(factory, d, workers=2, factory_kwargs=fkw,
+                           env=env, **kw)
+        for w, res in rep["worker_results"].items():
+            assert res["returncode"] == 0, (w, res)
+        store = CorpusStore(d, create=False)
+        assert store.load_triage_rows() is not None, \
+            "workers must write triage/ROWS.json on open"
+        n1, s1 = triage_snapshot(store)
+        n2, s2 = triage_snapshot(store)
+        with open(snapshot_path(store, n1), "rb") as f1, \
+                open(snapshot_path(store, n2), "rb") as f2:
+            assert f1.read() == f2.read(), \
+                "same store must snapshot byte-identically"
+        assert triage_diff(s1, s2)["empty"], "self-diff must be empty"
+
+        # mutate: open exactly one new bucket (distinct causal chain,
+        # the campaign plan's own base knob vector = torn recipe)
+        rt = _make_grayfail_runtime(**fkw)
+        plan = KnobPlan.from_runtime(rt)     # dup_slots=2, the default
+        chain = [dict(step=i, now=i * 10, kind=1, node=0, src=0,
+                      tag=4321 + i, parent=i - 1, lamport=i + 1)
+                 for i in range(3)]
+        fp = causal_fingerprint(dict(
+            chain=chain, truncated=False, root_external=True,
+            crashed=True, crash_code=997, crash_node=0, lane=0,
+            dropped=0))
+        key, opened = CrashBuckets(store).observe(
+            fp, seed=424242, knobs=plan.base_knobs(), round_no=5,
+            worker_id=0, chain=chain)     # observe() logs the line too
+        assert opened
+        n3, s3 = triage_snapshot(store)
+        diff = triage_diff(s2, s3)
+        assert diff["buckets"]["new"] == [key], diff["buckets"]
+        assert not diff["buckets"]["stale"], diff["buckets"]
+        assert s3["buckets"][key]["recipe"] == "torn_write", \
+            s3["buckets"][key]
+        a = s3["attribution"]
+        assert sum(a["recipe_coverage"].values()) \
+            == s3["store"]["coverage_total"]
+        assert sum(a["recipe_buckets"].values()) \
+            == s3["store"]["buckets_total"]
+        assert set(a["recipe_coverage"]) == set(RECIPE_FAMILIES) | {"base"}
+
+        # dashboard + terminal report
+        html = render_html(s3, diff)
+        html_path = os.path.join(root, "dash.html")
+        with open(html_path, "w") as f:
+            f.write(html)
+        assert "triage-root" in html and "<svg" in html \
+            and key[:16] in html and 'class="badge new"' in html
+        out = subprocess.run(
+            [sys.executable, "-m", "madsim_tpu.service.report", d,
+             "--against", "prev"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        assert out.returncode == 0, out.stderr[-800:]
+        assert "1 new" in out.stdout, out.stdout
+
+        # repro-health audit: one rotation step, verdict recorded
+        audit = audit_buckets(rt, store, max_steps=steps, budget=1,
+                              chunk=512)
+        assert len(audit["audited"]) == 1
+        verdict = audit["audited"][0]
+        assert verdict["status"] in ("pass", "fail", "flaky"), verdict
+        _n4, s4 = triage_snapshot(store)
+        assert s4["audit"][verdict["bucket"]]["status"] \
+            == verdict["status"]
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps({
+        "metric": "triage_smoke", "platform": "cpu", "ok": True,
+        "buckets": s3["store"]["buckets_total"],
+        "coverage": s3["store"]["coverage_total"],
+        "new_bucket": key, "audit": verdict["status"],
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
 def _regression_smoke_mode():
     """--regression-smoke: the durable corpus as a REGRESSION SUITE
     (OSS-Fuzz-style, r17): tests/data/regression_corpus/ holds committed
@@ -2790,7 +2906,7 @@ def main():
                  "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
                  "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke",
                  "--lat-ab", "--lat-smoke", "--grayfail-smoke",
-                 "--regression-smoke"}
+                 "--regression-smoke", "--triage-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
@@ -2803,6 +2919,9 @@ def main():
         return
     if "--regression-smoke" in sys.argv:
         _regression_smoke_mode()
+        return
+    if "--triage-smoke" in sys.argv:
+        _triage_smoke_mode()
         return
     if "--prof-ab" in sys.argv:
         _prof_ab_mode()

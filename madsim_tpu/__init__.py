@@ -53,8 +53,9 @@ from .runtime.runtime import Runtime
 from .runtime.scenario import Scenario
 from .search import (Corpus, KnobPlan, fuzz, fuzz_sharded, pct_sweep,
                      with_prio_nudge)
-from .service import (CorpusStore, campaign_report, merged_buckets,
-                      replay_bucket, run_campaign, supervise_campaign)
+from .service import (CorpusStore, audit_buckets, campaign_report,
+                      merged_buckets, replay_bucket, run_campaign,
+                      supervise_campaign, triage_diff, triage_snapshot)
 
 __version__ = "0.1.0"
 
@@ -73,6 +74,7 @@ __all__ = [
     "latency_summary", "format_latency",
     "CorpusStore", "run_campaign", "supervise_campaign", "campaign_report",
     "merged_buckets", "replay_bucket",
+    "triage_snapshot", "triage_diff", "audit_buckets",
     "lint_runtime", "find_races", "confirm_race", "scan_races",
     "detsan_check", "DetSanFailure",
 ]

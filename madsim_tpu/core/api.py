@@ -59,12 +59,14 @@ class Ctx:
              (``ctx.state = new_state``) to update.
     """
 
-    def __init__(self, cfg: T.SimConfig, node, now, key, state):
+    def __init__(self, cfg: T.SimConfig, node, now, key, state,
+                 hash_base=None):
         self.cfg = cfg
         self.node = node
         self.now = now
         self.state = state
         self._key = key
+        self._hash_base = hash_base
         self._sends: list[dict[str, Any]] = []
         self._timers: list[dict[str, Any]] = []
         self._cancels: list[dict[str, Any]] = []
@@ -86,6 +88,31 @@ class Ctx:
 
     def bernoulli(self, p) -> jax.Array:
         return prng.bernoulli(self.rand_key(), p)
+
+    # -- per-node deterministic hash streams (collections.rs parity) -------
+    def hash_key(self, stream=0) -> jax.Array:
+        """This node's deterministic HASH-SEED key for `stream` — a pure
+        function of (lane seed, ctx.node, stream), identical at every
+        event of every schedule (r18). madsim seeds each HashMap's
+        hasher from the sim rng (collections.rs) so iteration order is
+        replay-stable; the analog here: a model that needs hash-like
+        randomness (consistent-hash rings, probe orders, sampled
+        subsets) derives it from this stream instead of `rand_key()`,
+        whose value depends on the dispatch order — with `rand_key` a
+        different interleaving reseeds every node's hash state and
+        COUPLES nodes through the scheduler; with this stream node a's
+        hash order never moves node b's. Consumes nothing: calling it
+        (any number of times) leaves every other draw bit-identical."""
+        if self._hash_base is None:
+            raise ValueError(
+                "hash_key() needs the runtime's seed-derived hash base — "
+                "this Ctx was built without one (custom driver?); pass "
+                "hash_base=SimState.hash_base / seed_key(seed)")
+        return prng.node_hash_key(self._hash_base, self.node, stream)
+
+    def hash_randint(self, lo, hi, stream=0) -> jax.Array:
+        """Uniform int32 in [lo, hi] off this node's hash stream."""
+        return prng.randint(self.hash_key(stream), lo, hi)
 
     # -- effects -----------------------------------------------------------
     def send(self, dst, tag, payload=None, *, when=True) -> None:

@@ -38,3 +38,36 @@ def uniform(key) -> jax.Array:
 
 def bernoulli(key, p) -> jax.Array:
     return jax.random.uniform(key, (), dtype=jnp.float32) < p
+
+
+# Domain separator for the per-node HASH-SEED streams (r18): keeps the
+# (seed, node)-derived keys out of the trajectory key's split lineage,
+# so consuming a hash stream can never alias a scheduler/handler draw.
+HASH_STREAM_DOMAIN = 0x48534853  # "HSHS"
+
+
+def node_hash_key(seed_or_key, node, stream: int = 0) -> jax.Array:
+    """Node `node`'s deterministic hash-seed key, derived from
+    (seed, node, stream) alone — madsim's collections.rs parity: there
+    every HashMap gets its hasher seed from the sim rng so iteration
+    order is replay-stable; here a model that needs hash-like tie-break
+    randomness (consistent hashing, probe sequences, sampled sets)
+    draws it from this stream instead of `ctx.rand_key()`.
+
+    The property that matters: the stream is a pure function of
+    (seed, node), NOT of the schedule. A `ctx.rand_key()` draw in
+    `init` depends on how many events dispatched before this node's
+    boot — a different interleaving reseeds every node's hash state,
+    COUPLING nodes through the scheduler. This stream is identical
+    across schedules, and node a's stream never moves node b's.
+
+    Accepts the raw int seed or an already-derived uint32[2] key
+    (`SimState.hash_base` / `Ctx.hash_key` pass the latter).
+    Vmappable; consumes nothing from any other stream.
+    """
+    key = jnp.asarray(seed_or_key)
+    if key.ndim == 0:
+        key = seed_key(key)
+    k = jax.random.fold_in(key, HASH_STREAM_DOMAIN)
+    k = jax.random.fold_in(k, jnp.asarray(node, jnp.uint32))
+    return jax.random.fold_in(k, jnp.asarray(stream, jnp.uint32))

@@ -50,7 +50,15 @@ TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
                 "pf_on", "pf_dispatch", "pf_busy", "pf_kill", "pf_restart",
                 "pf_qmax", "pf_drop", "pf_delay",
                 "lh_on", "ev_root_t", "lh_sojourn", "lh_e2e",
-                "lh_slo_miss", "slo_target")
+                "lh_slo_miss", "slo_target",
+                "hash_base")
+# hash_base rides TRACE_FIELDS for the fingerprint-exclusion contract
+# only: it is a CONSTANT pure function of the lane's seed (never
+# written after init), so folding it into fingerprints would make two
+# seeds with identical trajectories fingerprint differently — breaking
+# distinct_outcomes. Unlike the recorder columns it IS consumed by the
+# replay domain when a model opts in (Ctx.hash_key), but the seed that
+# fingerprints already imply determines it completely.
 
 # pf_dispatch's kind axis: one column per event kind (EV_FREE's column
 # exists so t_kind values index directly but is never written — only
@@ -64,6 +72,17 @@ class SimState:
     # --- clock & rng & lifecycle -----------------------------------------
     now: jax.Array          # int32 ticks — virtual clock (ClockHandle analog)
     key: jax.Array          # uint32[2] — trajectory PRNG (GlobalRng analog)
+    hash_base: jax.Array    # uint32[2] — the lane's UNCONSUMED seed key
+                            # (seed_key(seed), frozen at init while `key`
+                            # splits away): the root of the per-node
+                            # deterministic HASH-SEED streams (r18,
+                            # madsim collections.rs parity). Ctx.hash_key
+                            # derives fold_in(fold_in(this, DOMAIN),
+                            # node) — a pure (seed, node) function, so
+                            # model-level hash iteration order is
+                            # schedule-stable and can't couple nodes.
+                            # Never written after init; excluded from
+                            # fingerprints (see TRACE_FIELDS note).
     halted: jax.Array       # bool — simulation finished (normally or crashed)
     crashed: jax.Array      # bool — an invariant/assertion failed
     crash_code: jax.Array   # int32 — which invariant (user >0, engine <0)
@@ -323,6 +342,9 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
     return SimState(
         now=jnp.asarray(0, i32),
         key=key,
+        # an OWNED copy, never the same buffer: runners donate the state,
+        # and two pytree leaves aliasing one buffer break donation
+        hash_base=jnp.array(key, copy=True),
         halted=jnp.asarray(False),
         crashed=jnp.asarray(False),
         crash_code=jnp.asarray(T.CRASH_NONE, i32),

@@ -340,7 +340,8 @@ def make_step(
                                                    ev_payload)),
                 (is_timer, lambda c: prog.on_timer(c, ev_tag, ev_payload)),
             ):
-                ctx = Ctx(cfg, h_node, h_now, k_handler, base_slice)
+                ctx = Ctx(cfg, h_node, h_now, k_handler, base_slice,
+                          hash_base=s.hash_base)
                 run(ctx)
                 combos.append((hkind & pmask, ctx))
 

@@ -36,12 +36,20 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   per-node e2e-p99 Perfetto track off the `tr_lat`
                   ring column. O(counters + buckets) per sweep crosses
                   the host boundary, at syncs the runners already pay.
+  * dashboard.py— (r18) the standing operator surface: render a triage
+                  snapshot (+ diff) from service/triage.py as ONE
+                  self-contained HTML file — inline-SVG sparklines for
+                  the coverage/rate/p99 curves, attribution bars,
+                  bucket lifecycle table with repro one-liners — no
+                  server, no JS deps; pure read side of the store.
 """
 
 from .causal import (causal_fingerprint, code_fingerprint, explain_crash,
                      fingerprints_match, happens_before, sketch_divergence)
+from .dashboard import render_html, sparkline_svg
 from .metrics import JsonlObserver, SweepObserver, TeeObserver
-from .profiler import (counter_track_events, export_profile_trace,
+from .profiler import (counter_track_events, curve_brief,
+                       export_profile_trace,
                        format_latency, format_profile,
                        latency_histogram_rows, latency_summary,
                        profile_summary)
@@ -58,4 +66,5 @@ __all__ = [
     "profile_summary", "format_profile", "counter_track_events",
     "export_profile_trace",
     "latency_summary", "format_latency", "latency_histogram_rows",
+    "render_html", "sparkline_svg", "curve_brief",
 ]

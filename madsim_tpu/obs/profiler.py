@@ -179,6 +179,25 @@ def format_latency(summary: dict, node_names=None) -> str:
     return "\n".join(lines)
 
 
+def curve_brief(curve) -> dict | None:
+    """Summarize a [[t, value], ...] series (the campaign timeline's
+    coverage/rate/p99 curves) into the stat-tile shape the triage
+    snapshots persist and the dashboard renders: point count, min /
+    p50 / p90 / max over values, and the last value. None for an empty
+    series (build without that plane). Deterministic — a pure function
+    of the series, so it is safe inside the byte-stable snapshot body."""
+    if not curve:
+        return None
+    vals = np.asarray([v for _t, v in curve], np.float64)
+    return dict(
+        n=int(len(curve)),
+        min=round(float(vals.min()), 3),
+        p50=round(float(np.percentile(vals, 50)), 3),
+        p90=round(float(np.percentile(vals, 90)), 3),
+        max=round(float(vals.max()), 3),
+        last=round(float(vals[-1]), 3))
+
+
 def latency_histogram_rows(state) -> list[dict] | None:
     """The merged histograms as JSON-able rows (one per bucket with any
     count): {bucket, lo_us, e2e, sojourn} — dashboard/ingest format.

@@ -95,6 +95,24 @@ class ProgressObserver:
                 f"buckets {rec['buckets']}  "
                 f"{rec['schedules_per_sec']:.1f} sched/s", force=True)
             return
+        if rec.get("kind") == "triage":
+            # service.triage snapshot at a supervisor segment boundary:
+            # the one-line "what changed" readout (full detail:
+            # python -m madsim_tpu.service.report <dir> --against prev)
+            if rec.get("empty"):
+                change = "no change"
+            elif "coverage_added" in rec:
+                change = (f"+{rec['coverage_added']} coverage  "
+                          f"{rec.get('buckets_new', 0)} new / "
+                          f"{rec.get('buckets_regressed', 0)} regressed / "
+                          f"{rec.get('buckets_stale', 0)} stale buckets")
+            else:
+                change = "baseline"
+            self._show(f"triage snapshot {rec['snapshot']:04d}  {change}",
+                       force=True)
+            self._line_open = False
+            self.stream.write("\n")
+            return
         if rec.get("kind") == "supervisor":
             # service.supervise_campaign segment boundary
             dead = rec.get("dead_workers") or []

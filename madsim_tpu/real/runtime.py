@@ -109,6 +109,11 @@ class RealRuntime:
         import random as _random
         self._loss_rng = _random.Random(seed)
         self.key = prng.seed_key(seed)
+        # the frozen seed key beside the splitting draw key: the real
+        # twin's Ctx.hash_key root (same (seed, node) derivation as the
+        # simulator's SimState.hash_base, so a model's hash streams are
+        # bit-identical across the two worlds)
+        self.hash_base = prng.seed_key(seed)
         self.nodes = [RealNode(i, self._boot_state(i))
                       for i in range(cfg.n_nodes)]
         self.t0 = time.monotonic()
@@ -316,8 +321,10 @@ class RealRuntime:
             prog = self.programs[p_idx]
             cfg = self.cfg
 
+            hash_base = self.hash_base
+
             def run(state, node, now, key, src, tag, payload):
-                ctx = Ctx(cfg, node, now, key, state)
+                ctx = Ctx(cfg, node, now, key, state, hash_base=hash_base)
                 self._invoke(prog, ctx, kind, src, tag, payload)
                 return (ctx.state, ctx._sends, ctx._timers, ctx._cancels,
                         ctx._crash, ctx._crash_code, ctx._halt)
@@ -419,7 +426,8 @@ class RealRuntime:
             self._run_compiled_event(n, kind, src, tag, pl)
             return
         prog = self.programs[p_idx]
-        ctx = Ctx(self.cfg, node_j, now_j, self._next_key(), n.state)
+        ctx = Ctx(self.cfg, node_j, now_j, self._next_key(), n.state,
+                  hash_base=self.hash_base)
         if kind == "init":
             src, tag, pl = None, None, None
         elif kind == "message":
@@ -460,6 +468,7 @@ class RealRuntime:
         programs = self.programs
         node_prog_j = jnp.asarray(self.node_prog, jnp.int32)
         P = cfg.payload_words
+        hash_base = self.hash_base
         def body(carry, xs):
             stacked, now = carry
             valid, node, kindc, src, tag, pl, key = xs
@@ -473,7 +482,8 @@ class RealRuntime:
                         (0, lambda c: prog.init(c)),
                         (1, lambda c: prog.on_message(c, src, tag, pl)),
                         (2, lambda c: prog.on_timer(c, tag, pl))):
-                    ctx = Ctx(cfg, node, now, key, base)
+                    ctx = Ctx(cfg, node, now, key, base,
+                              hash_base=hash_base)
                     run(ctx)
                     combos.append((valid & pmask & (kindc == code), ctx))
             any_h = jnp.asarray(False)

@@ -250,7 +250,11 @@ class Runtime:
         batched = jax.tree.map(
             lambda a: jnp.broadcast_to(a, (seeds.shape[0],) + a.shape),
             self._template)
-        batched = batched.replace(key=keys)
+        # hash_base keeps the UNCONSUMED seed key frozen beside the
+        # splitting trajectory key — the (seed, node) hash-stream root.
+        # An owned copy: aliasing keys' buffer would break donation
+        batched = batched.replace(key=keys,
+                                  hash_base=jnp.array(keys, copy=True))
         if trace_lanes is not None:
             if self.cfg.trace_cap == 0:
                 raise ValueError(

@@ -20,6 +20,48 @@ import numpy as np
 from ..core import types as T
 
 
+# -- recipe-family row classes (r18; service/triage.py attribution) --------
+# Every supervisor op belongs to one chaos-recipe FAMILY — the row-class
+# tags the campaign triage plane uses to attribute coverage keys and
+# crash buckets to the fault shape that earned them (runtime/chaos.py
+# recipes compose ops from exactly these families). Order IS precedence:
+# a scenario mixing families classifies as the first present — most
+# gray/specific first, so a gray_failure mix whose mutant kept its torn
+# kill reads "torn_write" even while its latency rows stay on. "none"
+# covers the classic lifecycle/partition/clog chaos (and a faultless
+# script); the triage accounting contract adds an explicit "base" class
+# for rows it cannot see at all — never a silent "other".
+RECIPE_FAMILIES = ("torn_write", "slow_disk", "clock_skew",
+                   "asym_partition", "loss_latency", "none")
+
+
+def row_recipe_class(op: int, torn: bool = False) -> str:
+    """The recipe family one scenario row encodes. OP_SET_DISK splits on
+    its torn flag (a torn-armed disk row is the torn_write_kill recipe's
+    signature; a plain latency stall is slow_disk)."""
+    from ..core import types as _T
+    if op == _T.OP_SET_DISK:
+        return "torn_write" if torn else "slow_disk"
+    if op == _T.OP_SET_SKEW:
+        return "clock_skew"
+    if op == _T.OP_PARTITION_ONEWAY:
+        return "asym_partition"
+    if op in (_T.OP_SET_LOSS, _T.OP_SET_LATENCY):
+        return "loss_latency"
+    return "none"
+
+
+def classify_recipe(row_classes) -> str:
+    """Fold per-row classes into ONE family by RECIPE_FAMILIES
+    precedence — the entry/bucket-level classifier (each coverage key
+    gets exactly one family, so attribution sums to the total)."""
+    present = set(row_classes)
+    for fam in RECIPE_FAMILIES:
+        if fam in present:
+            return fam
+    return "none"
+
+
 @dataclasses.dataclass
 class _Row:
     time: int
@@ -58,6 +100,21 @@ class Scenario:
 
     def has_halt(self) -> bool:
         return any(r.op == T.OP_HALT for r in self.rows)
+
+    def recipe_class(self) -> str:
+        """This script's recipe family (the classifier over the
+        describe()/parse() row table — triage attribution's view of a
+        scenario): `classify_recipe` over every row's class, with
+        OP_SET_DISK rows reading their torn flag from wherever build()
+        would encode it (payload_tail for builder rows, the full
+        payload's P-2 word for rows re-entered via KnobPlan)."""
+        def torn_of(r):
+            if r.op != T.OP_SET_DISK:
+                return False
+            vals = [0, 0] + list(r.payload_tail or r.payload)
+            return bool(vals[-2])
+        return classify_recipe(
+            row_recipe_class(r.op, torn_of(r)) for r in self.rows)
 
     _OP_NAMES = {
         T.OP_INIT: "boot", T.OP_KILL: "kill", T.OP_RESTART: "restart",

@@ -184,6 +184,11 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 "shards occupy worker_id*shards+s; pick a worker_id "
                 "outside every group's range (DESIGN §15)")
         buckets = CrashBuckets(store)
+        # the triage plane's read side needs the scenario row table to
+        # attribute coverage/buckets to recipe families without a
+        # Runtime (service/triage.py); write-once, identical bytes
+        # from every worker
+        store.write_triage_rows(plan)
         if corpus is None:
             corpus = store.load_corpus(
                 plan, worker_id=worker_id, rng_seed=rng_seed,
@@ -367,7 +372,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 key, opened = buckets.observe_lane(
                     state, int(i), seed=int(seeds[i]),
                     knobs=KnobPlan.lane(knobs_host, int(i)),
-                    round_no=r, worker_id=worker_id)
+                    round_no=r, worker_id=worker_id,
+                    last_op=int(last_op[int(i)]))
                 if opened:
                     opened_buckets.append(key)
         n_crashed += int(crashed.sum())

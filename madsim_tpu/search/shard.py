@@ -153,6 +153,9 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         # are per-corpus state, exactly like one handle per worker
         stores = [CorpusStore(corpus_dir, signature=sig) for _ in range(S)]
         buckets = CrashBuckets(stores[0])
+        # triage-plane row table (service/triage.py attribution) —
+        # write-once, identical bytes from every worker/shard
+        stores[0].write_triage_rows(plan)
         group = stores[0].load_shard_group_state(worker_id)
         from ..service.store import StoreMismatch
         if group and group.get("shards") != S:
@@ -449,7 +452,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 key, opened = buckets.observe_lane(
                     state, int(i), seed=int(seeds[i]),
                     knobs=KnobPlan.lane(knobs_host, int(i)),
-                    round_no=r, worker_id=eff_w[int(i) // batch])
+                    round_no=r, worker_id=eff_w[int(i) // batch],
+                    last_op=int(last_op[int(i)]))
                 if opened:
                     opened_buckets.append(key)
         n_crashed += int(crashed.sum())

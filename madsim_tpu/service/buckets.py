@@ -70,7 +70,8 @@ class CrashBuckets:
     def observe(self, fp: dict, *, seed: int, knobs: dict | None,
                 round_no: int, worker_id: int, chain: list | None = None,
                 state=None, lane: int | None = None,
-                nudge: int | None = None) -> tuple[str, bool]:
+                nudge: int | None = None,
+                last_op: int | None = None) -> tuple[str, bool]:
         """Fold one crash observation in. Returns (bucket key, opened):
         `opened` is True when this observation created a new bucket (and
         wrote its repro + trace artifacts); an observation matching an
@@ -81,7 +82,14 @@ class CrashBuckets:
         (analyze/races.py, fp kind="race"): the race only manifests
         under that PCT tie-break policy, so the full replay handle is
         (seed, knobs, nudge) — `search.pct.with_prio_nudge` applies the
-        third leg at replay."""
+        third leg at replay.
+
+        `last_op` (r18) records the havoc operator that produced the
+        crashing lane's knob vector (KnobPlan.mutate's per-lane
+        attribution; -1 = untouched/bootstrap) into the bucket record —
+        the triage plane's per-operator bucket attribution; buckets
+        without it (pre-r18, or races) attribute to the explicit
+        `base` class."""
         self.refresh()
         key = self._match(fp)
         opened = key is None
@@ -97,6 +105,8 @@ class CrashBuckets:
                 chain=[{k: int(c[k]) for k in c} for c in (chain or [])],
                 repro=repro,
                 created_at=time.time())
+            if last_op is not None:
+                rec["op"] = int(last_op)
             self.store.write_bucket(key, rec, knobs=knobs)
             if state is not None and lane is not None:
                 from ..obs.trace import export_chrome_trace
@@ -113,7 +123,8 @@ class CrashBuckets:
 
     def observe_lane(self, state, lane: int, *, seed: int,
                      knobs: dict | None, round_no: int,
-                     worker_id: int) -> tuple[str, bool]:
+                     worker_id: int,
+                     last_op: int | None = None) -> tuple[str, bool]:
         """Fingerprint one crashed lane straight off its ring. Falls back
         to the code fingerprint when the build compiled lineage out
         (cfg.trace_cap == 0) — coarser buckets, still deduped."""
@@ -128,15 +139,21 @@ class CrashBuckets:
                 None, None
         return self.observe(fp, seed=seed, knobs=knobs, round_no=round_no,
                             worker_id=worker_id, chain=chain, state=state,
-                            lane=lane)
+                            lane=lane, last_op=last_op)
 
 
-def merged_buckets(store: CorpusStore) -> list[dict]:
+def merged_buckets(store: CorpusStore, log: list | None = None) -> list[dict]:
     """The read-side truth: all buckets, with suffix-matching ones folded
     together (repairing the concurrent-open race and cross-ring-depth
     splits). Deepest chain wins as canonical; observation counts come
-    from the telemetry log. Deterministic: candidates are processed in
-    (depth desc, key) order."""
+    from the telemetry log DEDUPED by (fingerprint, worker, round) —
+    a killed worker's interrupted round re-appends its observation line
+    on resume, and counting the replay twice inflated every bug-rate
+    curve downstream (campaign_report). Deterministic: candidates are
+    processed in (depth desc, key) order. `log` short-circuits the
+    observation-log read with an already-deduped row list — a caller
+    that needs the rows itself (triage_snapshot) parses the file once
+    and shares."""
     recs = [store.load_bucket(k) for k in store.bucket_keys()]
     recs.sort(key=lambda r: (-r["fingerprint"]["depth"], r["key"]))
     merged: list[dict] = []
@@ -151,7 +168,7 @@ def merged_buckets(store: CorpusStore) -> list[dict]:
         else:
             home["members"].append(rec["key"])
     by_member = {k: m for m in merged for k in m["members"]}
-    for line in store.bucket_log():
+    for line in (store.bucket_log_deduped() if log is None else log):
         m = by_member.get(line.get("bucket"))
         if m is not None:
             m["observations"] += 1

@@ -122,7 +122,10 @@ def campaign_stats(corpus_dir: str, *, uptime_s: float = 0.0,
     wall = max([s.get("wall_s", 0.0) for s in states], default=0.0)
     rounds_done = sum(s.get("rounds_done", 0) for s in states)
     buckets = store.bucket_keys()
-    crash_obs = len(store.bucket_log())
+    # deduped by (fingerprint, worker, round): a resumed worker's
+    # replayed round re-appends identical observation lines, which
+    # inflated the rate curves (store.bucket_log_deduped)
+    crash_obs = len(store.bucket_log_deduped())
     return dict(
         kind="campaign", round=round_no, uptime_s=round(uptime_s, 2),
         workers=workers, workers_alive=workers_alive,
@@ -220,13 +223,20 @@ def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
                        worker ran with the SLO latency plane compiled
                        in (cfg.latency_hist > 0, r16); empty otherwise
       workers_health   {label: {last_seen, age_s, rounds_done, sync_gap_s,
-                       stale}} — `stale` means no row within
-                       `stale_after` × the worker's own observed sync
-                       cadence of the campaign's latest activity (`now`
-                       defaults to the newest row's timestamp, so a
-                       finished campaign reads healthy and a worker that
-                       died unresumed reads stale — its last counters
-                       are history, not current state)
+                       stale}} — `stale` means the CAMPAIGN has newer
+                       activity than the worker: no row of this worker
+                       within `stale_after` × its own observed sync
+                       cadence of the newest row ANY worker appended.
+                       Staleness is always measured against that newest
+                       row, never against `now` — a worker whose last
+                       row IS the campaign's latest activity can't be
+                       stale, so a finished campaign (one worker
+                       included) reads healthy no matter how long ago
+                       it finished, while a worker that died unresumed
+                       beside still-running peers reads stale — its
+                       last counters are history, not current state.
+                       `now` (default: the newest row's timestamp) only
+                       scales the reported age_s.
     """
     by_worker = store.read_metrics()
     rows = []
@@ -248,8 +258,8 @@ def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
                 sync_gap_s=round(float(np.median(gaps)) if gaps else 0.0,
                                  3))
     rows.sort(key=lambda r: (r.get("t", 0.0), r.get("rounds_done", 0)))
-    t_ref = (now if now is not None
-             else max((r.get("t", 0.0) for r in rows), default=0.0))
+    t_latest = max((r.get("t", 0.0) for r in rows), default=0.0)
+    t_ref = now if now is not None else t_latest
     for label, h in health.items():
         h["age_s"] = round(max(t_ref - h["last_seen"], 0.0), 3)
         # a worker with one row has no observed cadence — only flag it
@@ -257,7 +267,13 @@ def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
         gap = h["sync_gap_s"] or max(
             (g["sync_gap_s"] for g in health.values() if g["sync_gap_s"]),
             default=0.0)
-        h["stale"] = bool(gap and h["age_s"] > stale_after * gap)
+        # stale only when the CAMPAIGN has newer activity than the
+        # worker: the lag is vs the newest row any worker appended, not
+        # vs `now` — a finished campaign's last-syncing worker (its own
+        # worker, in the 1-worker case) would otherwise read stale the
+        # moment a late report passed a wall-clock `now`
+        lag = max(t_latest - h["last_seen"], 0.0)
+        h["stale"] = bool(gap and lag > stale_after * gap)
     t0 = rows[0].get("t", 0.0) if rows else 0.0
     coverage_curve = []
     rate_curve = []
@@ -402,7 +418,7 @@ def supervise_campaign(factory: str, corpus_dir: str, *, workers: int = 2,
                        observer=None, env: dict | None = None,
                        poll_s: float = 2.0,
                        python: str = sys.executable,
-                       run_segment=None) -> dict:
+                       run_segment=None, triage: bool = True) -> dict:
     """The always-on supervisor loop (the r11 follow-on): run campaign
     SEGMENTS back to back, each rotating the per-worker `max_rounds`
     target up by `rounds_per_segment` — so `run_campaign`'s
@@ -419,13 +435,24 @@ def supervise_campaign(factory: str, corpus_dir: str, *, workers: int = 2,
         weight in the scheduler orders; pruning keeps parent sampling
         sharp without ever forgetting coverage.
 
+      - SNAPSHOTS the triage plane (`triage=True`, the default): one
+        `service.triage.triage_snapshot` per segment, so a long
+        campaign accretes a diffable `triage/` history for free —
+        `python -m madsim_tpu.service.report <dir> --against prev`
+        answers "what did the last segment buy" without re-reading raw
+        entry files (the snapshot walk is O(new files) on the
+        supervisor's long-lived store handle, like the poll loop).
+
     `run_segment` injects the segment runner (tests stub it); default
-    is `run_campaign`. Returns {segments: [per-segment report summary],
-    restarts, pruned, report: final merged campaign_report}."""
+    is `run_campaign`. Returns {segments: [per-segment report summary
+    incl. its snapshot number], restarts, pruned, report: final merged
+    campaign_report}."""
     runner = run_campaign if run_segment is None else run_segment
     seg_rows = []
     restarts = 0
     pruned_total = 0
+    triage_store = None
+    prev_snap = None
     for seg in range(segments):
         target = (seg + 1) * rounds_per_segment
         rep = runner(factory, corpus_dir, workers=workers,
@@ -443,12 +470,31 @@ def supervise_campaign(factory: str, corpus_dir: str, *, workers: int = 2,
             pr = prune_cold_entries(corpus_dir, below=prune_below,
                                     keep_min=prune_keep_min)
             pruned_total += pr["pruned"]
+        snap_no = None
+        if triage and os.path.exists(
+                os.path.join(corpus_dir, "MANIFEST.json")):
+            from .triage import triage_diff, triage_snapshot
+            if triage_store is None:
+                triage_store = CorpusStore(corpus_dir, create=False)
+            snap_no, snap = triage_snapshot(triage_store)
+            if observer is not None:
+                rec = dict(kind="triage", segment=seg, snapshot=snap_no)
+                if prev_snap is not None:
+                    d = triage_diff(prev_snap, snap)
+                    rec.update(
+                        empty=d["empty"],
+                        coverage_added=d["coverage"]["added"],
+                        **{f"buckets_{k}": len(v)
+                           for k, v in d["buckets"].items()})
+                observer.on_round(rec)
+            prev_snap = snap
         seg_rows.append(dict(
             segment=seg, max_rounds=target,
             rounds_done=rep.get("rounds_done", 0),
             coverage_keys=rep.get("coverage_keys", 0),
             buckets=rep.get("buckets", 0),
-            dead_workers=dead))
+            dead_workers=dead,
+            snapshot=snap_no))
         if observer is not None:
             observer.on_round(dict(kind="supervisor", segment=seg,
                                    max_rounds=target,

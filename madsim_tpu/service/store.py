@@ -217,6 +217,11 @@ class CorpusStore:
         # coverage keys cache forever on a store handle — keeps the
         # campaign driver's poll loop O(new entries), not O(corpus)
         self._hash_cache: dict[str, int] = {}
+        # triage-plane sibling cache (r18, service/triage.py): per entry
+        # file, (coverage hash, recipe family) — same immutability
+        # argument, so repeated snapshots off one handle re-read each
+        # raw entry file at most once (O(new files), like the poll loop)
+        self._triage_cache: dict[str, tuple] = {}
 
     # -- naming --------------------------------------------------------
     @staticmethod
@@ -556,6 +561,49 @@ class CorpusStore:
                 self._hash_cache[n] = self.load_entry(n)["hash"]
         return set(self._hash_cache.values())
 
+    # -- triage plane (r18, service/triage.py) -------------------------
+    def triage_dir(self) -> str:
+        """The standing triage history subdir (ADDITIVE: no store schema
+        bump — pre-r18 stores open cleanly and simply have no triage/
+        yet). Holds numbered snapshots (NNNN.json), the scenario row
+        table the recipe classifier needs (ROWS.json), and the
+        repro-health audit ledger (AUDIT.json)."""
+        return os.path.join(self.dir, "triage")
+
+    def triage_rows_path(self) -> str:
+        return os.path.join(self.triage_dir(), "ROWS.json")
+
+    def write_triage_rows(self, plan) -> None:
+        """Persist the base scenario ROW TABLE the recipe classifier
+        reads (op codes + the classifier-relevant guards/flags) — the
+        read side of attribution must not need the Runtime. Derived
+        deterministically from the KnobPlan, so every worker writes
+        identical bytes; skipped once present (write-once)."""
+        p = self.triage_rows_path()
+        if os.path.exists(p):
+            return
+        os.makedirs(self.triage_dir(), exist_ok=True)
+        P = int(plan.payload_words)
+        pay = np.asarray(plan.base["payload"])
+        base_torn = (pay[:, P - 2] & 1 if P >= 2
+                     else np.zeros(plan.R, np.int32))
+        _atomic_bytes(p, (json.dumps(dict(
+            op=[int(x) for x in np.asarray(plan.base["op"])],
+            drop_ok=[bool(x) for x in np.asarray(plan.drop_ok)],
+            torn_ok=[bool(x) for x in np.asarray(plan.torn_ok)],
+            base_torn=[int(x) for x in base_torn]),
+            sort_keys=True, indent=1) + "\n").encode())
+
+    def load_triage_rows(self) -> dict | None:
+        """The persisted row table, or None (pre-r18 store / no worker
+        wrote it yet) — attribution then reports everything under the
+        explicit `base` class instead of guessing."""
+        try:
+            with open(self.triage_rows_path()) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+
     # -- crash buckets (plumbing for service/buckets.py) ---------------
     def bucket_path(self, key: str, suffix: str = ".json") -> str:
         return os.path.join(self.buckets_dir, key + suffix)
@@ -608,4 +656,26 @@ class CorpusStore:
                 line = line.strip()
                 if line:
                     out.append(json.loads(line))
+        return out
+
+    def bucket_log_deduped(self) -> list[dict]:
+        """The observation log with replayed duplicates collapsed:
+        rows dedup by (fingerprint, worker, round), first kept. The
+        append-only log gains an IDENTICAL line whenever a killed
+        worker's interrupted round re-runs on resume (the append-
+        before-sync ordering re-observes the same representative lane),
+        and fuzz logs one representative per distinct code per round —
+        so within one (fp, worker, round) a second line is always a
+        replay artifact, never a new observation. Rate/observation
+        consumers (campaign_stats, merged_buckets) fold THIS view; the
+        raw log stays the forensic record."""
+        seen: set[tuple] = set()
+        out = []
+        for line in self.bucket_log():
+            k = (line.get("fp_key", line.get("bucket")),
+                 line.get("worker_id"), line.get("round"))
+            if k in seen:
+                continue
+            seen.add(k)
+            out.append(line)
         return out

@@ -79,6 +79,13 @@ case "$tier" in
     # a torn-write fuzz campaign must open causal-fingerprint crash
     # buckets with replayable (seed, knobs) handles
     python bench.py --grayfail-smoke
+    # campaign-triage smoke: a 2-worker campaign must snapshot
+    # byte-stably into the triage/ history, a planted bucket must diff
+    # as exactly one `new` entry with its torn_write recipe
+    # attribution (both attribution dimensions summing to their
+    # totals), the standing HTML dashboard must render, and the
+    # repro-health audit must record a verdict via replay_bucket
+    python bench.py --triage-smoke
     # regression gate (OSS-Fuzz-style): every committed crash bucket in
     # tests/data/regression_corpus must still reproduce (run-twice
     # verified) and the top-energy corpus slice must still land on its

@@ -40,8 +40,11 @@ class TestEquivalenceR16:
     def test_leaf_for_leaf_vs_r16_golden(self, workload):
         # scripts/capture_golden.py froze these digests AT r16 HEAD,
         # before any r17 engine change: every r16 leaf must still hash
-        # identically, chunked and fused. New r17 leaves (skew/disk_lat/
-        # torn) are allowed — they are what simconfig-v5 gates.
+        # identically, chunked and fused. New leaves are allowed only by
+        # name: r17's gray-failure plane (skew/disk_lat/torn, gated by
+        # simconfig-v5) and r18's hash_base (the frozen seed key — a
+        # constant that consumes nothing, which is exactly why every
+        # OTHER leaf must still match r16 bit for bit).
         gold = golden.load_golden()[workload]
         got = golden.run_workload(workload)
         for runner in ("run", "run_fused"):
@@ -51,7 +54,8 @@ class TestEquivalenceR16:
                     if gold[runner][k] != got[runner][k]]
             assert not diff, (runner, diff)
             new = set(got[runner]) - set(gold[runner])
-            assert new == {".skew", ".disk_lat", ".torn"}, new
+            assert new == {".skew", ".disk_lat", ".torn",
+                           ".hash_base"}, new
 
 
 # ---------------------------------------------------------------------------
