@@ -797,6 +797,59 @@ def _make_grayfail_runtime(recipe="mix", trace_cap=128, n_ops=12):
                                    sync_commits=sync, scenario=sc, cfg=cfg)
 
 
+def _make_connfault_runtime(recipe="mix", trace_cap=128, n_txns=6,
+                            guard=None):
+    """The connection-fault flagship targets (r19, DESIGN §20): minipg —
+    pipelined exactly-once transactions over the full conn+stream stack —
+    under the chaos recipes whose fault shapes its client-side oracles
+    catch. One canonical definition — --conn-smoke, --regression-smoke,
+    the search_ab connfault regime, and tests/test_connfault.py import
+    it.
+
+      mix    reset storm on the server + dup storms on every node (the
+             fuzz regime: every row/target/rate mutable).
+             GUARDS OFF by default here — minipg with incarnation guards
+             is designed to survive this regime, so the crash-rich
+             search target is the pre-r19 transport (the honest control
+             that proves the guard; pass guard=True for the green side)
+      reset  conn_reset_storm alone (guards on — the recovery regime)
+      dup    retransmit_storm alone (guards on — transport dedup regime)
+      half   half_open_churn: kill/restart leaves survivors half-open,
+             a trailing reset-peer pulse finally tears both sides
+    """
+    from madsim_tpu import NetConfig, Scenario, SimConfig, ms, sec
+    from madsim_tpu.models.minipg import make_minipg_runtime
+    from madsim_tpu.runtime import chaos
+    sc = Scenario()
+    if guard is None:
+        guard = recipe != "mix"
+    if recipe == "mix":
+        # no latency-fattening row: a fatter floor drains the windows by
+        # the reset instants and the stale-segment overlap vanishes (the
+        # latency knobs stay mutable through latency_perturb regardless)
+        for n in range(3):
+            sc.at(ms(8)).set_dup(n, 0.35)
+        sc = chaos.conn_reset_storm(rounds=5, first=ms(30), period=ms(60),
+                                    node=0, sc=sc)
+        sc = chaos.retransmit_storm(ms(400), 0.5, ms(900), node=0, sc=sc)
+    elif recipe == "reset":
+        sc = chaos.conn_reset_storm(rounds=5, first=ms(30), period=ms(60),
+                                    node=0, sc=sc)
+    elif recipe == "dup":
+        for n in range(3):
+            sc = chaos.retransmit_storm(ms(5), 0.4, ms(800), node=n, sc=sc)
+    else:
+        assert recipe == "half", recipe
+        sc = chaos.half_open_churn(0, rounds=2, first=ms(60),
+                                   period=ms(400), down=ms(100), sc=sc)
+    cfg = SimConfig(n_nodes=3, event_capacity=192, payload_words=8,
+                    time_limit=sec(10), trace_cap=trace_cap,
+                    net=NetConfig(send_latency_min=ms(1),
+                                  send_latency_max=ms(8)))
+    return make_minipg_runtime(n_clients=2, n_txns=n_txns, scenario=sc,
+                               cfg=cfg, epoch_guard=guard)
+
+
 def _search_ab_mode():
     """--mode search_ab: coverage-guided fuzzer vs blind explore() at
     EQUAL device-dispatch budget (same rounds x batch x max_steps), on
@@ -832,7 +885,7 @@ def _search_ab_mode():
     if "--regime" in sys.argv:
         regime_filter = sys.argv[sys.argv.index("--regime") + 1]
         known = ("saturating", "flagship_raft_chaos", "crashrich_wal_kv",
-                 "crashrich_chain", "grayfail")
+                 "crashrich_chain", "grayfail", "connfault")
         if not any(n == regime_filter or n.startswith(regime_filter)
                    for n in known):
             # a typo must not run zero regimes, write no artifact, and
@@ -1016,6 +1069,77 @@ def _search_ab_mode():
                             "platform": platform, "grayfail": row},
                            measured_at=time.strftime("%F %T")), f,
                       indent=1)
+    if want("connfault"):
+        # the r19 connection-fault regime: fuzzer vs blind on the minipg
+        # exactly-once flagship under the composed reset+dup storm with
+        # the incarnation guards compiled to the pre-r19 behavior (the
+        # crash-rich control — the guarded build is designed to survive
+        # this recipe, which tests/test_connfault.py asserts separately).
+        # Same protocol as the grayfail regime: the fuzzer side runs
+        # DURABLY so crashes dedup into causal-fingerprint buckets.
+        import shutil
+        import tempfile
+        rounds_c, batch_c, steps_c = 4, 128 if big else 96, 30_000
+        row = {"rounds": rounds_c, "batch": batch_c,
+               "max_steps": steps_c,
+               "note": ("minipg with epoch guards OFF (pre-r19 "
+                        "transport) under conn_reset_storm + "
+                        "retransmit_storm; fuzzer side is a durable "
+                        "campaign — crashes dedup by causal fingerprint "
+                        "into buckets; blind's distinct_crash_codes is "
+                        "the coarser stand-in")}
+        warm = _make_connfault_runtime("mix")
+        explore(warm, max_steps=steps_c, batch=batch_c, max_rounds=1,
+                dry_rounds=2, chunk=512)
+        fuzz(warm, max_steps=steps_c, batch=batch_c, max_rounds=2,
+             dry_rounds=3, chunk=512)
+        rt_b = _make_connfault_runtime("mix")
+        t0 = time.perf_counter()
+        res_b = explore(rt_b, max_steps=steps_c, batch=batch_c,
+                        max_rounds=rounds_c, dry_rounds=rounds_c + 1,
+                        chunk=512)
+        dt_b = time.perf_counter() - t0
+        row["blind"] = {
+            "distinct_schedules": res_b["distinct_schedules"],
+            "distinct_crash_codes": len(res_b["crash_first_seed_by_code"]),
+            "wall_s": round(dt_b, 2),
+            "schedules_per_device_sec": round(
+                res_b["distinct_schedules"] / dt_b, 1)}
+        tmp = tempfile.mkdtemp(prefix="connfault_ab_")
+        try:
+            rt_f = _make_connfault_runtime("mix")
+            t0 = time.perf_counter()
+            res_f = fuzz(rt_f, max_steps=steps_c, batch=batch_c,
+                         max_rounds=rounds_c, dry_rounds=rounds_c + 1,
+                         chunk=512, corpus_dir=tmp)
+            dt_f = time.perf_counter() - t0
+            row["fuzzer"] = {
+                "distinct_schedules": res_f["distinct_schedules"],
+                "distinct_crash_codes": len(res_f["crash_repros"]),
+                "crash_buckets": res_f["buckets_total"],
+                "wall_s": round(dt_f, 2),
+                "schedules_per_device_sec": round(
+                    res_f["distinct_schedules"] / dt_f, 1),
+                "crash_buckets_per_device_sec": round(
+                    res_f["buckets_total"] / dt_f, 3),
+                "mutation_yield": res_f["mutation_yield"]}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        row["fuzzer_vs_blind_schedules"] = round(
+            row["fuzzer"]["distinct_schedules"]
+            / max(row["blind"]["distinct_schedules"], 1), 2)
+        out["regimes"]["connfault"] = row
+        print(f"--search-ab: connfault fuzzer "
+              f"{row['fuzzer']['distinct_schedules']} schedules / "
+              f"{row['fuzzer']['crash_buckets']} buckets vs blind "
+              f"{row['blind']['distinct_schedules']}", file=sys.stderr)
+        cpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             f"BENCH_connfault_ab_{platform}.json")
+        with open(cpath, "w") as f:
+            json.dump(dict({"metric": "connfault_ab",
+                            "platform": platform, "connfault": row},
+                           measured_at=time.strftime("%F %T")), f,
+                      indent=1)
     if "saturating" in out["regimes"]:
         sat = out["regimes"]["saturating"]
         out["fuzzer_beats_blind_on_saturating"] = (
@@ -1138,6 +1262,96 @@ def _grayfail_smoke_mode():
         "metric": "grayfail_smoke", "platform": "cpu", "ok": True,
         "skew_crash_lanes": int(lanes.size),
         "torn_buckets": res["buckets_total"],
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
+def _conn_smoke_mode():
+    """--conn-smoke: seconds-scale connection-fault-plane self-test for
+    CI (scripts/ci.sh fast):
+
+      1. OP_RESET_PEER is observed on BOTH sides — a connected pair's
+         conn state drops to CLOSED at both endpoints and both
+         incarnation epochs bump (the reset_node parity; a plain kill
+         leaves the survivor's half-open state, asserted as the
+         contrast);
+      2. incarnation REJECTION reproduces on single-lane seed replay —
+         a guards-off reset+dup storm lane that crashed replays
+         fingerprint-exact by seed, and the guards-ON build completes
+         the same storm (both directions of the flagship contract);
+      3. a small durable fuzz campaign on the guards-off mix opens >= 1
+         causal-fingerprint crash bucket whose (seed, knobs) handle
+         replays red via replay_bucket.
+    """
+    _force_cpu_inprocess()
+    import shutil
+    import tempfile
+    import numpy as np
+    from madsim_tpu import Scenario, fuzz, ms, replay_bucket
+    from madsim_tpu.models.minipg import make_minipg_runtime
+    t0 = time.perf_counter()
+
+    # 1. both-sides teardown vs the kill's deliberate half-open — halt
+    # right after the fault so the sample precedes watchdog recovery
+    def final_conn(reset: bool):
+        sc = Scenario()
+        if reset:
+            sc.at(ms(400)).reset_peer(0)
+        else:
+            sc.at(ms(400)).kill(0)
+        sc.at(ms(401)).halt()
+        rt = make_minipg_runtime(n_clients=2, n_txns=50, scenario=sc)
+        fin = rt.run_fused(rt.init_batch(np.arange(8, dtype=np.uint32)),
+                           20_000, 512)
+        cn = np.asarray(fin.node_state["cn_state"])
+        ep = np.asarray(fin.node_state["cn_epoch"])
+        return cn, ep
+    cn_r, ep_r = final_conn(True)
+    assert (cn_r[:, 0, 1:] == 0).all(), "server side must read CLOSED"
+    assert (cn_r[:, 1:, 0] == 0).all(), "client sides must read CLOSED"
+    assert (ep_r[:, 0, 1:] >= 1).all() and (ep_r[:, 1:, 0] >= 1).all(), \
+        "both sides' incarnation epochs must bump"
+    cn_k, _ = final_conn(False)
+    assert (cn_k[:, 1:, 0] == 2).any(), \
+        "a kill must leave some survivor half-open (ESTABLISHED)"
+
+    # 2. flagship both directions + fingerprint-exact red replay
+    rt_g = _make_connfault_runtime("mix", guard=True)
+    fin_g = rt_g.run_fused(
+        rt_g.init_batch(np.arange(48, dtype=np.uint32)), 120_000, 512)
+    done = np.asarray(fin_g.node_state["c_done"])[:, 1:]
+    assert bool(done.all()) and not np.asarray(fin_g.crashed).any(), \
+        "guards-on flagship must survive the storm"
+    rt_r = _make_connfault_runtime("mix")
+    fin_r = rt_r.run_fused(
+        rt_r.init_batch(np.arange(48, dtype=np.uint32)), 120_000, 512)
+    lanes = np.nonzero(np.asarray(fin_r.crashed))[0]
+    assert lanes.size > 0, "guards-off storm found no crash lane"
+    lane = int(lanes[0])
+    code = int(np.asarray(fin_r.crash_code)[lane])
+    fp_batch = int(rt_r.fingerprints(fin_r)[lane])
+    rt_r2 = _make_connfault_runtime("mix")
+    rep = rt_r2.run_fused(
+        rt_r2.init_batch(np.asarray([lane], np.uint32)), 120_000, 512)
+    assert int(np.asarray(rep.crash_code)[0]) == code
+    assert int(rt_r2.fingerprints(rep)[0]) == fp_batch, \
+        "seed replay diverged from the batch lane"
+
+    # 3. dup-storm fuzz buckets by causal fingerprint, replayable red
+    tmp = tempfile.mkdtemp(prefix="conn_smoke_")
+    try:
+        rt3 = _make_connfault_runtime("mix")
+        res = fuzz(rt3, max_steps=30_000, batch=64, max_rounds=3,
+                   dry_rounds=4, chunk=512, corpus_dir=tmp)
+        assert res["buckets_total"] >= 1, res
+        for key in res["buckets_opened"] or []:
+            crashed, bcode, _ = replay_bucket(rt3, tmp, key, 30_000)
+            assert crashed, (key, bcode)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "metric": "conn_smoke", "platform": "cpu", "ok": True,
+        "red_lanes": int(lanes.size), "red_code": code,
+        "buckets": res["buckets_total"],
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
@@ -2906,7 +3120,7 @@ def main():
                  "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
                  "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke",
                  "--lat-ab", "--lat-smoke", "--grayfail-smoke",
-                 "--regression-smoke", "--triage-smoke"}
+                 "--regression-smoke", "--triage-smoke", "--conn-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
@@ -2916,6 +3130,9 @@ def main():
         return
     if "--grayfail-smoke" in sys.argv:
         _grayfail_smoke_mode()
+        return
+    if "--conn-smoke" in sys.argv:
+        _conn_smoke_mode()
         return
     if "--regression-smoke" in sys.argv:
         _regression_smoke_mode()

@@ -185,6 +185,25 @@ class SimState:
                             # the step already made, so enabling it never
                             # shifts the PRNG stream of anything else.
 
+    # --- connection-fault plane (r19; DESIGN §20) --------------------------
+    dup_rate: jax.Array     # int32[N] — per-node duplicate-delivery rate
+                            # in PARTS PER MILLION (the OP_SET_LOSS
+                            # encoding), set by OP_SET_DUP and capped at
+                            # DUP_RATE_CAP: a dispatched MESSAGE at the
+                            # node is re-armed for one more delivery with
+                            # this probability instead of being freed —
+                            # byte-identical payload, later deadline, and
+                            # it may duplicate again (the retransmit-storm
+                            # regime). The decision/delay draws ride keys
+                            # FOLDED off the already-consumed scheduler
+                            # key, so the zero default consumes nothing
+                            # from any stream — bit-identical to r18
+                            # (tests/test_connfault.py holds it against
+                            # golden digests captured at r18 HEAD).
+                            # Replay-domain state like skew/disk_lat:
+                            # rides in fingerprints and checkpoints
+                            # (simconfig-v6 rejects pre-r19 snapshots).
+
     # --- schedule search (search/pct.py) ----------------------------------
     prio_nudge: jax.Array   # int32 — PCT-style priority-perturbation point.
                             # 0 (the default) leaves the scheduler's random
@@ -379,6 +398,7 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         skew=jnp.zeros((N,), i32),
         disk_lat=jnp.zeros((N,), i32),
         torn=jnp.zeros((N,), bool),
+        dup_rate=jnp.zeros((N,), i32),
         prio_nudge=jnp.asarray(0, i32),
         msg_sent=jnp.asarray(0, i32),
         msg_delivered=jnp.asarray(0, i32),

@@ -228,6 +228,22 @@ def make_step(
         sched_hash = jnp.where(valid, (s.sched_hash ^ ev_mix) * fold,
                                s.sched_hash)
 
+        # ---- duplicate-delivery fault (r19; DESIGN §20) ------------------
+        # A dispatched MESSAGE may be delivered AGAIN: with the acting
+        # node's per-million dup rate (OP_SET_DUP), the popped row is
+        # re-armed at a fresh latency draw instead of being freed —
+        # byte-identical payload/provenance/root, later deadline, and the
+        # duplicate can duplicate again (the retransmit-storm regime).
+        # Both draws ride keys FOLDED off k_sched, which the tie-break
+        # already consumed, so the zero-rate default consumes nothing
+        # from any other stream — trajectories stay bit-identical to r18
+        # (the golden-digest contract, tests/test_connfault.py).
+        dup_p = (sel.take1(s.dup_rate, ev_node).astype(jnp.float32)
+                 * jnp.float32(1e-6))
+        k_dupf = jax.random.fold_in(k_sched, 0x44555031)
+        dup_fire = (valid & (ev_kind == T.EV_MSG)
+                    & prng.bernoulli(k_dupf, dup_p))
+
         # pop the slot; clock never runs backward (resumed nodes' past-due
         # events fire "now", the park/unpark analog of task.rs:134-137)
         now = jnp.where(valid, jnp.maximum(s.now, dmin), s.now)
@@ -266,14 +282,27 @@ def make_step(
         # strict >: the scenario's HALT op sits at exactly time_limit, and
         # same-deadline ties may dispatch before it without being late
         time_over = now > s.tlimit
+        # the duplicate's redelivery instant: a fresh network-latency draw
+        # past the dispatch (never the same tick — the copy is a distinct
+        # future delivery, like the reference's re-sent datagram)
+        k_dupd = jax.random.fold_in(k_sched, 0x44555032)
+        redeliver = now + jnp.maximum(
+            prng.randint(k_dupd, s.lat_lo, s.lat_hi), 1)
         s = s.replace(
             key=key,
             now=now,
             sched_hash=sched_hash,
+            # a duplicating dispatch keeps its row (kind/node/src/tag/
+            # payload — and ev_prov/ev_root_t, which the pop never
+            # touches) and only moves the deadline; everything else frees
             t_kind=sel.put_row(s.t_kind, idx,
-                               jnp.asarray(T.EV_FREE, s.t_kind.dtype), valid),
+                               jnp.asarray(T.EV_FREE, s.t_kind.dtype),
+                               valid & ~dup_fire),
             t_deadline=sel.put_row(s.t_deadline, idx,
-                                   jnp.asarray(T.T_INF, jnp.int32), valid),
+                                   jnp.where(dup_fire, redeliver,
+                                             jnp.asarray(T.T_INF,
+                                                         jnp.int32)),
+                                   valid),
         )
 
         # ---- 2. supervisor op (Handle::kill/restart/... as events) ---------
@@ -880,6 +909,43 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
             fs_dlen=sel.put_row(ns["fs_dlen"], target,
                                 jnp.maximum(dlen_t, cut), tearing))
 
+    # connection-fault tear (r19, DESIGN §20): OP_RESET_PEER kills every
+    # live conn/stream touching the target, on BOTH sides — the
+    # NetSim::reset_node parity a kill deliberately lacks (the survivor
+    # keeps half-open state; only a reset tears streams down). For any
+    # state schema carrying the conn/stream leaf quartets: cn_state rows
+    # AND columns of the target drop to CLOSED, the stream rings/counters
+    # touching it wipe, and both sides' incarnation epochs bump — the RST
+    # notification, applied atomically to both endpoints, so segments and
+    # RSTs still in flight from the torn incarnation are STALE to the
+    # successor connection (net/stream.py drop-on-less rule). Masked
+    # edits only; inert for schemas without the leaves, and a no-op mask
+    # costs the same selects the other per-node ops already pay.
+    rp = when(op == T.OP_RESET_PEER)
+    if isinstance(ns, dict):
+        touched = (ohT[:, None] | ohT[None, :]) & rp        # [N, N]
+
+        def _cut(col, zero):
+            m = touched.reshape(touched.shape
+                                + (1,) * (col.ndim - 2))
+            return jnp.where(m, zero, col)
+
+        if {"cn_state", "cn_epoch"} <= set(ns.keys()):
+            ns = dict(ns,
+                      cn_state=_cut(ns["cn_state"], 0),
+                      cn_epoch=ns["cn_epoch"]
+                      + touched.astype(jnp.int32))
+        if {"sx_seq", "sx_base", "sx_val", "sr_next", "sr_val",
+                "sr_have", "st_epoch"} <= set(ns.keys()):
+            ns = dict(ns,
+                      st_epoch=ns["st_epoch"] + touched.astype(jnp.int32),
+                      sx_seq=_cut(ns["sx_seq"], 0),
+                      sx_base=_cut(ns["sx_base"], 0),
+                      sr_next=_cut(ns["sr_next"], 0),
+                      sx_val=_cut(ns["sx_val"], 0),
+                      sr_val=_cut(ns["sr_val"], 0),
+                      sr_have=_cut(ns["sr_have"], False))
+
     # node boot/restart resets protocol state to the spec default — process
     # memory does not survive a crash. Leaves marked persistent are stable
     # storage (the FsSim analog) and DO survive.
@@ -933,10 +999,15 @@ def _apply_super(cfg, spec_default, persist_mask, s: SimState, op, node, src,
     disk_lat = jnp.where(ohDk, jnp.clip(payload[P - 1], 0, T.DISK_LAT_CAP),
                          s.disk_lat)
     torn = jnp.where(ohDk, payload[P - 2] != 0, s.torn)
+    ohDup = ohT & when(op == T.OP_SET_DUP)
+    dup_rate = jnp.where(ohDup,
+                         jnp.clip(payload[P - 1], 0, T.DUP_RATE_CAP),
+                         s.dup_rate)
 
     init_node = jnp.where(boot, target, jnp.asarray(-1, jnp.int32))
     s = s.replace(t_kind=t_kind, t_deadline=t_deadline, alive=alive,
                   paused=paused, node_state=node_state, clog_node=clog_node,
                   clog_link=clog_link, loss=loss, lat_lo=lat_lo,
-                  lat_hi=lat_hi, skew=skew, disk_lat=disk_lat, torn=torn)
+                  lat_hi=lat_hi, skew=skew, disk_lat=disk_lat, torn=torn,
+                  dup_rate=dup_rate)
     return s, init_node, target, (kill | boot)

@@ -81,12 +81,37 @@ OP_SET_DISK = 17     # per-node disk fault: payload[LAST] = disk latency in
                      # unsynced tail to disk — a partially-written final
                      # record instead of clean old-or-new; fs-layer models
                      # only). Same pool/value packing as OP_SET_SKEW.
+# --- connection-fault ops (r19) ---------------------------------------------
+OP_RESET_PEER = 18   # tear down ALL conn/stream fabric touching the target
+                     # node, on BOTH sides (madsim NetSim::reset_node parity,
+                     # sim/net/tcp/stream.rs:185-192: live TCP connections
+                     # die; a kill alone deliberately leaves the survivor's
+                     # half-open state): every cn_state entry touching the
+                     # node drops to CLOSED, every stream ring/counter
+                     # touching it is wiped, and both sides' incarnation
+                     # epochs bump — so in-flight segments and RSTs from the
+                     # torn incarnation are rejected by the successor
+                     # connection (DESIGN §20). Inert for state schemas
+                     # without the conn/stream leaf quartets (like torn
+                     # mode for non-fs models). Target may be NODE_RANDOM
+                     # with a pool, like every node-lifecycle op.
+OP_SET_DUP = 19      # per-node duplicate-delivery rate: payload[LAST] =
+                     # rate * 1e6 (the OP_SET_LOSS encoding). A dispatched
+                     # MESSAGE at the node is re-armed for one more
+                     # delivery with that probability instead of being
+                     # freed — the retransmit-storm / datagram-duplication
+                     # regime Go-Back-N's exactly-once claim must survive.
+                     # Duplicates can duplicate again (geometric storm,
+                     # bounded by the rate cap). Same pool/value packing
+                     # as OP_SET_SKEW.
 
 # bounds enforced wherever the values enter state (supervisor op apply,
 # KnobPlan.apply): skew is a rate in 1/1024ths (±512 = ±50% clock rate),
-# disk latency is capped at 10 simulated seconds
+# disk latency is capped at 10 simulated seconds, duplicate delivery at
+# 0.9 (like the loss-mutation cap: past that lanes mostly stall)
 SKEW_CAP = 512
 DISK_LAT_CAP = 10_000_000
+DUP_RATE_CAP = 900_000
 
 # Node argument sentinel: draw a random target at fire time (fuzzing aid).
 # KILL/PAUSE/CLOG pick a random *alive* node; RESTART picks a random *dead* one.
@@ -426,7 +451,7 @@ class SimConfig:
         ride as operands. `emission_write` stays raw here — 'auto'
         resolves per backend at trace time, and the cache keys the
         backend separately."""
-        return ("simconfig-v5", self.n_nodes, self.event_capacity,
+        return ("simconfig-v6", self.n_nodes, self.event_capacity,
                 self.payload_words, self.table_dtype, self.emission_write,
                 bool(self.collect_stats), self.trace_cap_bucket,
                 self.sketch_slots, self.net.op_jitter_max > 0,

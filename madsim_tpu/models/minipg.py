@@ -108,10 +108,17 @@ def pg_persist_spec(spec):
 
 
 class PgServer(Program):
-    def __init__(self, n_nodes: int, n_keys: int, tick=ms(10)):
+    def __init__(self, n_nodes: int, n_keys: int, tick=ms(10),
+                 epoch_guard: bool = True):
         self.n = n_nodes
         self.K = n_keys
         self.tick = tick
+        # r19 incarnation guard (net/conn.py, net/stream.py): True is the
+        # sound default; False compiles the pre-r19 accept-everything
+        # transport — the honest red control tests/test_connfault.py and
+        # bench's connfault regime use to PROVE the guard is what makes
+        # exactly-once survive connection churn
+        self.guard = epoch_guard
 
     # ---- response ring (strict output order + backpressure) -------------
     def _rpush(self, st, src, words, when):
@@ -253,16 +260,19 @@ class PgServer(Program):
     def on_message(self, ctx: Ctx, src, tag, payload):
         st = dict(ctx.state)
         from ..utils.maskutil import needed
-        accept, _, rst = conn.on_message(ctx, st, src, tag)
-        # a (re)connecting or resetting peer voids its stream, session and
-        # pending output — new connection, new world
+        accept, _, rst = conn.on_message(ctx, st, src, tag, payload,
+                                         epoch_guard=self.guard)
+        # a (re)connecting or resetting peer voids its session and
+        # pending output — new connection, new world (the conn layer
+        # already rebased the stream fabric onto the negotiated
+        # incarnation, r19)
         fresh = accept | rst
         if needed(fresh):
-            stream.reset_peer(st, src, when=fresh)
             for k in ("rb_w", "rb_r", "sess", "txn", "tb_n"):
                 st[k] = st[k].at[src].set(jnp.where(fresh, 0, st[k][src]))
 
-        vals, mask = stream.on_message(ctx, st, src, tag, payload)
+        vals, mask = stream.on_message(ctx, st, src, tag, payload,
+                                       epoch_guard=self.guard)
         for i in stream.delivered_slots(mask):
             self._frame(ctx, st, src, vals[i], mask[i])
         self._drain(ctx, st)
@@ -275,11 +285,12 @@ class PgClient(Program):
     auth-refusal path (expects ERROR/RST, never READY)."""
 
     def __init__(self, n_txns: int = 4, tick=ms(8), stall=ms(250),
-                 wrong_password: bool = False):
+                 wrong_password: bool = False, epoch_guard: bool = True):
         self.T = n_txns
         self.tick = tick
         self.stall = stall
         self.wrong = wrong_password
+        self.guard = epoch_guard
 
     def _keys(self, ctx):
         base = (ctx.node - 1) * 2
@@ -434,7 +445,8 @@ class PgClient(Program):
 
     def on_message(self, ctx: Ctx, src, tag, payload):
         st = dict(ctx.state)
-        _, _, rst = conn.on_message(ctx, st, src, tag)
+        _, _, rst = conn.on_message(ctx, st, src, tag, payload,
+                                    epoch_guard=self.guard)
         # server reset (or refusal): back to square one, unless we're the
         # wrong-password client, for whom RST is the expected outcome
         if self.wrong:
@@ -443,7 +455,8 @@ class PgClient(Program):
         else:
             self._reset_session(ctx, st,
                                 rst & (st["c_done"] == 0))
-        vals, mask = stream.on_message(ctx, st, src, tag, payload)
+        vals, mask = stream.on_message(ctx, st, src, tag, payload,
+                                       epoch_guard=self.guard)
         for i in stream.delivered_slots(mask):
             self._result(ctx, st, vals[i], mask[i] & (src == SERVER))
         ctx.state = st
@@ -456,7 +469,7 @@ def clients_done(n_nodes: int):
 
 
 def make_minipg_runtime(n_clients=2, n_txns=4, scenario=None, cfg=None,
-                        wrong_password=False):
+                        wrong_password=False, epoch_guard=True):
     from ..core.types import NetConfig, SimConfig, sec
     from ..runtime.runtime import Runtime
     n = 1 + n_clients
@@ -467,8 +480,9 @@ def make_minipg_runtime(n_clients=2, n_txns=4, scenario=None, cfg=None,
                         net=NetConfig(send_latency_min=ms(1),
                                       send_latency_max=ms(8)))
     spec = pg_state_spec(n, n_keys)
-    server = PgServer(n, n_keys)
-    client = PgClient(n_txns, wrong_password=wrong_password)
+    server = PgServer(n, n_keys, epoch_guard=epoch_guard)
+    client = PgClient(n_txns, wrong_password=wrong_password,
+                      epoch_guard=epoch_guard)
     node_prog = np.asarray([0] + [1] * n_clients, np.int32)
     return Runtime(cfg, [server, client], spec, node_prog=node_prog,
                    scenario=scenario, persist=pg_persist_spec(spec),

@@ -176,10 +176,11 @@ class StreamEchoServer(Service):
         # would desynchronize the windows forever
         from ..utils.maskutil import needed
         st = dict(ctx.state)
-        accept, _, rst = conn.on_message(ctx, st, src, tag)
+        accept, _, rst = conn.on_message(ctx, st, src, tag, payload)
         fresh = accept | rst
         if needed(fresh):
-            streaming.reset_peer(st, src, when=fresh)
+            # the conn layer already rebased the stream fabric onto the
+            # negotiated incarnation (r19); only the app state resets here
             for k in ("eb_w", "eb_r", "eb_end", "acc", "dl_rem", "dl_end"):
                 st[k] = st[k].at[src].set(jnp.where(fresh, 0, st[k][src]))
         ctx.state = st
@@ -271,10 +272,10 @@ class StreamEchoClient(Program):
         from ..net.stream import delivered_slots
         from ..utils.maskutil import needed
         st = dict(ctx.state)
-        _, _, rst = conn.on_message(ctx, st, src, tag)
-        # server reset our connection: start over (fresh call id next tick)
+        _, _, rst = conn.on_message(ctx, st, src, tag, payload)
+        # server reset our connection: start over (fresh call id next
+        # tick; the conn layer already tore the stream fabric)
         if needed(rst):
-            streaming.reset_peer(st, SERVER, when=rst)
             for k in ("c_sent", "c_fin", "c_got"):
                 st[k] = jnp.where(rst, 0, st[k])
             st["c_phase"] = jnp.where(rst, 0, st["c_phase"])
@@ -328,7 +329,7 @@ def make_stream_echo_runtime(mode: str, n_clients: int = 2, n_items: int = 6,
                         time_limit=sec(10),
                         net=NetConfig(send_latency_min=ms(1),
                                       send_latency_max=ms(8)))
-    assert cfg.payload_words >= 1 + streaming.HEADER_WORDS + 1
+    assert cfg.payload_words >= 2 + streaming.HEADER_WORDS + 1
     server = StreamEchoServer(n)
     client = StreamEchoClient(mode, n_items)
     node_prog = np.asarray([0] + [1] * n_clients, np.int32)

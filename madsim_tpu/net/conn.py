@@ -14,6 +14,20 @@ listening. A SYN to a non-listening node draws RST. Death detection is the
 application's concern (as in the reference, where only a *reset* — not a
 kill alone — tears streams down).
 
+PEER INCARNATIONS (r19, DESIGN §20): every peering carries an epoch
+counter (`cn_epoch[peer]`) that strictly increases across connection
+generations. The handshake NEGOTIATES the generation: a SYN proposes the
+initiator's epoch, the listener accepts at max(proposal, own) and echoes
+it in the SYN-ACK, so both endpoints land on the same value — and any
+stream fabric present in the same state dict is re-based onto it
+(stream.reset_peer(epoch=)). Every RST names the generation it tears
+(its payload word), so a DELAYED RST from a pre-reset incarnation is
+rejected instead of closing the successor connection; every local or
+remote teardown bumps the counter, so the next negotiated generation
+strictly exceeds every segment still in flight from the torn one.
+`OP_RESET_PEER` (core/step.py) applies the same teardown+bump to both
+endpoints atomically — the reset_node parity.
+
 All helpers are masked/traceable; see tests/test_conn.py for the idiom.
 """
 
@@ -29,12 +43,26 @@ TAG_RST = (1 << 21) + 2
 
 CLOSED, SYN_SENT, ESTABLISHED = 0, 1, 2
 
+# stream-fabric leaves conn re-bases on handshake/teardown when the model
+# composes both layers in one state dict (the minipg/stream_echo idiom)
+_STREAM_KEYS = frozenset(
+    ("sx_seq", "sx_base", "sx_val", "sr_next", "sr_val", "sr_have",
+     "st_epoch"))
+
 
 def conn_state(n_nodes: int):
     return dict(
         cn_state=jnp.zeros((n_nodes,), jnp.int32),   # per-peer conn state
         cn_listen=jnp.asarray(0, jnp.int32),         # listening flag
+        # per-peer incarnation counter: the connection GENERATION this
+        # node will propose/accept next; strictly increases across
+        # resets, negotiated to a common value at each handshake
+        cn_epoch=jnp.zeros((n_nodes,), jnp.int32),
     )
+
+
+def _has_stream(st) -> bool:
+    return _STREAM_KEYS <= set(st.keys())
 
 
 def listen(ctx: Ctx, st, *, when=True):
@@ -45,7 +73,8 @@ def listen(ctx: Ctx, st, *, when=True):
 def connect(ctx: Ctx, st, dst, *, when=True):
     """Initiate a handshake (TcpStream::connect). Completion is observed
     via is_established once the SYN-ACK returns; pair with a retry timer
-    for lossy networks."""
+    for lossy networks. The SYN proposes this node's epoch for the new
+    connection generation (r19)."""
     from ..utils.maskutil import statically_false
     if statically_false(when):
         return jnp.asarray(False)
@@ -56,7 +85,7 @@ def connect(ctx: Ctx, st, dst, *, when=True):
                               | (st["cn_state"][dst] == SYN_SENT))
     st["cn_state"] = st["cn_state"].at[dst].set(
         jnp.where(ok, SYN_SENT, st["cn_state"][dst]))
-    ctx.send(dst, TAG_SYN, [0], when=ok)
+    ctx.send(dst, TAG_SYN, [st["cn_epoch"][dst]], when=ok)
     return ok
 
 
@@ -64,48 +93,104 @@ def is_established(st, peer):
     return st["cn_state"][jnp.asarray(peer, jnp.int32)] == ESTABLISHED
 
 
-def on_message(ctx: Ctx, st, src, tag):
+def on_message(ctx: Ctx, st, src, tag, payload=None, *, epoch_guard=True):
     """Feed connection-control messages through the state machine. Returns
     (accepted, established, reset) masks for this event. Call before
     stream.on_message; data for CLOSED peers should be ignored by the app.
+
+    `payload` carries the epoch word of the r19 handshake frames; passing
+    None degrades to epoch 0 everywhere (legacy call sites — the guard
+    then never rejects, which is also what `epoch_guard=False` selects:
+    the pre-r19 behavior where ANY RST closes an ESTABLISHED connection
+    regardless of incarnation; kept compilable as the honest red control
+    for the exactly-once flagship).
     """
-    from ..utils.maskutil import statically_false
+    from ..utils.maskutil import needed, statically_false
     if statically_false((tag == TAG_SYN) | (tag == TAG_SYN_ACK)
                         | (tag == TAG_RST)):
         f = jnp.asarray(False)
         return f, f, f
     src = jnp.asarray(src, jnp.int32)
+    carried = (jnp.asarray(payload[0], jnp.int32) if payload is not None
+               else jnp.asarray(0, jnp.int32))
 
     # listener side: SYN while listening -> ESTABLISHED + SYN-ACK;
-    # SYN while not listening -> RST (connection refused)
+    # SYN while not listening -> RST (connection refused). The accepted
+    # generation is max(proposal, own counter) — monotone across resets
+    # on EITHER side, idempotent for duplicate SYNs of the same dial.
     is_syn = tag == TAG_SYN
     accept = is_syn & (st["cn_listen"] == 1)
     refuse = is_syn & (st["cn_listen"] != 1)
+    e_acc = jnp.maximum(carried, st["cn_epoch"][src])
+    st["cn_epoch"] = st["cn_epoch"].at[src].set(
+        jnp.where(accept, e_acc, st["cn_epoch"][src]))
     st["cn_state"] = st["cn_state"].at[src].set(
         jnp.where(accept, ESTABLISHED, st["cn_state"][src]))
-    ctx.send(src, TAG_SYN_ACK, [0], when=accept)
-    ctx.send(src, TAG_RST, [0], when=refuse)
+    ctx.send(src, TAG_SYN_ACK, [e_acc], when=accept)
+    # a refusal RST names the generation the SYN proposed, so the
+    # initiator recognizes it as aimed at ITS current dial
+    ctx.send(src, TAG_RST, [carried], when=refuse)
+    if needed(accept) and _has_stream(st):
+        # fresh connection, fresh stream fabric, re-based on the
+        # negotiated generation (both endpoints land on the same value).
+        # ONLY when the generation actually advances: a network-
+        # DUPLICATED SYN of the current generation (the r19 dup-storm
+        # fault) re-accepts with the same epoch, and re-wiping then
+        # would reopen the receive window — already-delivered same-
+        # epoch segments would deliver again, breaking exactly-once
+        from . import stream
+        stream.reset_peer(st, src,
+                          when=accept & (e_acc > st["st_epoch"][src]),
+                          epoch=e_acc)
 
-    # initiator side: SYN-ACK completes the handshake
+    # initiator side: SYN-ACK completes the handshake and installs the
+    # negotiated generation (>= the proposal by construction)
     is_sa = (tag == TAG_SYN_ACK) & (st["cn_state"][src] == SYN_SENT)
+    st["cn_epoch"] = st["cn_epoch"].at[src].set(
+        jnp.where(is_sa, jnp.maximum(carried, st["cn_epoch"][src]),
+                  st["cn_epoch"][src]))
     st["cn_state"] = st["cn_state"].at[src].set(
         jnp.where(is_sa, ESTABLISHED, st["cn_state"][src]))
+    if needed(is_sa) and _has_stream(st):
+        # same advance-only gate as the accept side: a dup-storm copy
+        # of the SYN-ACK must not re-wipe the initiator's fabric
+        from . import stream
+        e_sa = jnp.maximum(carried, st["cn_epoch"][src])
+        stream.reset_peer(st, src,
+                          when=is_sa & (e_sa > st["st_epoch"][src]),
+                          epoch=e_sa)
 
-    # RST tears the connection down (ConnectionReset)
+    # RST tears the connection down (ConnectionReset) — but only an RST
+    # aimed at THIS incarnation (its payload word == our counter): a
+    # delayed RST from a torn generation is noise, not a teardown
+    # (satellite fix r19; epoch_guard=False restores the pre-r19 close-
+    # on-any-RST behavior). A valid RST bumps the counter so the next
+    # negotiated generation strictly exceeds the torn one.
     is_rst = tag == TAG_RST
+    if epoch_guard:
+        is_rst = is_rst & (carried == st["cn_epoch"][src])
     st["cn_state"] = st["cn_state"].at[src].set(
         jnp.where(is_rst, CLOSED, st["cn_state"][src]))
+    st["cn_epoch"] = st["cn_epoch"].at[src].set(
+        st["cn_epoch"][src] + is_rst)
+    if needed(is_rst) and _has_stream(st):
+        # the torn generation's in-flight segments must be stale to
+        # whatever connection comes next
+        from . import stream
+        stream.reset_peer(st, src, when=is_rst)
 
     return accept, is_sa, is_rst
 
 
 def reset(ctx: Ctx, st, peer, *, when=True):
-    """Abort a connection and notify the peer (the reset-on-close path)."""
+    """Abort a connection and notify the peer (the reset-on-close path).
+    The RST names the torn generation; the local counter bumps past it."""
     from ..utils.maskutil import statically_false
     if statically_false(when):
         return
     peer = jnp.asarray(peer, jnp.int32)
     w = jnp.asarray(when) & (st["cn_state"][peer] != CLOSED)
+    ctx.send(peer, TAG_RST, [st["cn_epoch"][peer]], when=w)
     st["cn_state"] = st["cn_state"].at[peer].set(
         jnp.where(w, CLOSED, st["cn_state"][peer]))
-    ctx.send(peer, TAG_RST, [0], when=w)
+    st["cn_epoch"] = st["cn_epoch"].at[peer].set(st["cn_epoch"][peer] + w)

@@ -5,11 +5,24 @@ The reference gives applications `TcpStream` objects backed by an in-memory
 duplex ring buffer with loss-free FIFO delivery (sim/net/tcp/stream.rs:
 96-126), while its datagram Endpoint may drop and reorder. Here the same
 split exists: the engine's messages are UDP-like (latency jitter reorders,
-loss drops, clogs block), and this module layers TCP semantics on top as a
-state-machine library: sliding-window transmission, cumulative acks,
-timer-driven retransmission, exactly-once in-order delivery. Window slots
-are a fixed ring (seq % window), so everything is static-shape and
-vectorizes across the seed batch.
+loss drops, clogs block — and under the r19 dup-storm knob, DUPLICATES),
+and this module layers TCP semantics on top as a state-machine library:
+sliding-window transmission, cumulative acks, timer-driven retransmission,
+exactly-once in-order delivery. Window slots are a fixed ring (seq %
+window), so everything is static-shape and vectorizes across the seed
+batch.
+
+PEER INCARNATIONS (r19, DESIGN §20): every DATA and ACK frame is stamped
+with the sender's per-peer stream epoch (`st_epoch[peer]` — the
+connection GENERATION, negotiated by net/conn.py's handshake or bumped
+locally by `reset_peer`). The receiver drops frames from an OLDER
+generation (a killed-and-restarted peer's stale retransmits can no
+longer be accepted into the fresh sequence space — the corruption this
+plane exists to prevent) and ADOPTS a newer one (the reset it missed:
+wipe both directions, jump the epoch, process the frame). A stale ACK is
+equally rejected — it must not slide the successor window. Pass
+`epoch_guard=False` to `on_message` to compile the pre-r19 accept-
+everything behavior (the flagship's honest red control).
 
 Usage inside a Program (see tests/test_stream.py):
     spec = {**my_spec, **stream.stream_state(n_nodes, window=4)}
@@ -36,7 +49,8 @@ def stream_state(n_nodes: int, window: int = 4, item_words: int = 1):
     item_words > 1 makes each stream element a fixed int32 vector instead of
     a scalar (the framed-message case: streaming RPC items, file chunks) —
     rings gain a trailing [item_words] axis and send/on_message move whole
-    vectors. Requires payload_words >= 1 + item_words.
+    vectors. Requires payload_words >= 2 + item_words (seq + epoch +
+    item — the r19 incarnation stamp widened every frame by one word).
     """
     N, W, V = n_nodes, window, item_words
     z = jnp.zeros((N,), jnp.int32)
@@ -48,6 +62,7 @@ def stream_state(n_nodes: int, window: int = 4, item_words: int = 1):
         sr_next=z,                                 # next expected seq (rx)
         sr_val=jnp.zeros(shape, jnp.int32),        # out-of-order ring
         sr_have=jnp.zeros((N, W), bool),
+        st_epoch=z,                                # peering incarnation
     )
 
 
@@ -73,10 +88,10 @@ def _as_item(val, V):
     return val
 
 
-def _data_payload(seq, item, V):
+def _data_payload(seq, epoch, item, V):
     if V == 1:
-        return [seq, item]
-    return jnp.concatenate([jnp.stack([seq]), item])
+        return [seq, epoch, item]
+    return jnp.concatenate([jnp.stack([seq, epoch]), item])
 
 
 def send(ctx: Ctx, st, dst, val, *, when=True):
@@ -96,13 +111,21 @@ def send(ctx: Ctx, st, dst, val, *, when=True):
     st["sx_val"] = st["sx_val"].at[dst, slot].set(
         jnp.where(ok, val, st["sx_val"][dst, slot]))
     st["sx_seq"] = st["sx_seq"].at[dst].set(seq + ok)
-    ctx.send(dst, TAG_DATA, _data_payload(seq, val, V), when=ok)
+    ctx.send(dst, TAG_DATA, _data_payload(seq, st["st_epoch"][dst], val, V),
+             when=ok)
     return ok
 
 
 def retransmit(ctx: Ctx, st, dst, *, when=True):
     """Resend every unacked value to `dst` (cumulative-ack Go-Back-N).
-    Arm a periodic timer and call this on fire."""
+    Arm a periodic timer and call this on fire.
+
+    Incarnation contract (r19 satellite): a timer that fires AFTER
+    `reset_peer` tore this peer's fabric is a structural no-op — the
+    reset zeroed sx_base == sx_seq, so no slot is live — and anything it
+    WOULD send stamps the CURRENT epoch, so a stale timer can never
+    inject old-incarnation segments into the successor connection
+    (tests/test_connfault.py holds reset-between-send-and-fire)."""
     from ..utils.maskutil import statically_false
     if statically_false(when):
         return
@@ -115,7 +138,8 @@ def retransmit(ctx: Ctx, st, dst, *, when=True):
         if statically_false(live):
             continue
         ctx.send(dst, TAG_DATA,
-                 _data_payload(seq, st["sx_val"][dst, seq % W], V),
+                 _data_payload(seq, st["st_epoch"][dst],
+                               st["sx_val"][dst, seq % W], V),
                  when=live)
 
 
@@ -138,16 +162,9 @@ def delivered_slots(mask):
     return np.nonzero(np.asarray(mask))[0].tolist()
 
 
-def reset_peer(st, peer, *, when=True):
-    """Wipe both directions of the stream to `peer` (fresh sequence space).
-    Pair with conn-layer reset/reconnect: a restarted peer lost its stream
-    state, so the survivor must restart the sequence space too — exactly a
-    new TCP connection after the old one died (stream.rs:162-209)."""
-    from ..utils.maskutil import statically_false
-    if statically_false(when):
-        return
-    peer = jnp.asarray(peer, jnp.int32)
-    w = jnp.asarray(when)
+def _wipe_peer(st, peer, w):
+    """Zero both directions of the ring/counter fabric to `peer` under
+    mask `w` — shared by reset_peer and the on_message adoption path."""
     z = jnp.zeros((), jnp.int32)
     for k in ("sx_seq", "sx_base", "sr_next"):
         st[k] = st[k].at[peer].set(jnp.where(w, z, st[k][peer]))
@@ -159,13 +176,46 @@ def reset_peer(st, peer, *, when=True):
         jnp.where(w, False, st["sr_have"][peer]))
 
 
-def on_message(ctx: Ctx, st, src, tag, payload):
+def reset_peer(st, peer, *, when=True, epoch=None):
+    """Wipe both directions of the stream to `peer` (fresh sequence space)
+    and advance the peering's incarnation. Pair with conn-layer
+    reset/reconnect: a restarted peer lost its stream state, so the
+    survivor must restart the sequence space too — exactly a new TCP
+    connection after the old one died (stream.rs:162-209).
+
+    `epoch=None` (standalone use) bumps the incarnation by one — the old
+    generation's in-flight segments and acks become STALE to this
+    endpoint. The conn layer instead passes the handshake-NEGOTIATED
+    generation so both endpoints land on the same value (conn.py r19)."""
+    from ..utils.maskutil import statically_false
+    if statically_false(when):
+        return
+    peer = jnp.asarray(peer, jnp.int32)
+    w = jnp.asarray(when)
+    _wipe_peer(st, peer, w)
+    new_ep = (st["st_epoch"][peer] + 1 if epoch is None
+              else jnp.asarray(epoch, jnp.int32))
+    st["st_epoch"] = st["st_epoch"].at[peer].set(
+        jnp.where(w, new_ep, st["st_epoch"][peer]))
+
+
+def on_message(ctx: Ctx, st, src, tag, payload, *, epoch_guard=True):
     """Feed a received message through the stream layer.
 
     Returns (vals, mask): up to `window` values newly deliverable IN ORDER
     (mask[i] marks validity; process them with masked ops). vals has shape
     [window] for scalar streams, [window, item_words] for vector streams.
     Non-stream tags return an all-False mask — safe to call unconditionally.
+
+    Incarnation guard (r19): payload[1] carries the sender's stream epoch
+    on every DATA and ACK frame. Frames from an OLDER generation than
+    `st_epoch[src]` are dropped (no delivery, no re-ack, no window
+    slide); a NEWER generation is ADOPTED — both directions wiped, epoch
+    jumped — before the frame is processed, covering the endpoint that
+    missed a reset. `epoch_guard=False` compiles the pre-r19 behavior
+    (every frame accepted regardless of incarnation) — the red control
+    that lets tests PROVE the guard is what makes restart-under-churn
+    sound.
     """
     from ..utils.maskutil import statically_false
     W, V = _window(st), _item_words(st)
@@ -175,11 +225,28 @@ def on_message(ctx: Ctx, st, src, tag, payload):
     from ..utils.maskutil import needed
     src = jnp.asarray(src, jnp.int32)
 
-    # ---- DATA: buffer in-window segments, deliver the contiguous run ----
     is_data = tag == TAG_DATA
+    is_ack = tag == TAG_ACK
+    if epoch_guard:
+        ep = jnp.asarray(payload[1], jnp.int32)
+        cur = st["st_epoch"][src]
+        relevant = is_data | is_ack
+        fresh = relevant & (ep > cur)
+        stale = relevant & (ep < cur)
+        if needed(fresh):
+            # the peer moved to a newer incarnation (a reset this side
+            # missed): wipe both directions onto it, then let the frame
+            # land in the fresh window
+            _wipe_peer(st, src, fresh)
+            st["st_epoch"] = st["st_epoch"].at[src].set(
+                jnp.where(fresh, ep, cur))
+        is_data = is_data & ~stale
+        is_ack = is_ack & ~stale
+
+    # ---- DATA: buffer in-window segments, deliver the contiguous run ----
     if needed(is_data):
         seq = payload[0]
-        val = payload[1] if V == 1 else payload[1:1 + V]
+        val = payload[2] if V == 1 else payload[2:2 + V]
         nxt = st["sr_next"][src]
         in_win = is_data & (seq >= nxt) & (seq < nxt + W)
         slot = seq % W
@@ -200,15 +267,16 @@ def on_message(ctx: Ctx, st, src, tag, payload):
             jnp.where(deliver, False, st["sr_have"][src, (nxt + offs) % W]))
         st["sr_next"] = st["sr_next"].at[src].set(
             nxt + jnp.where(is_data, count, 0))
-        # cumulative ack (also for duplicates below the window — re-ack)
-        ctx.send(src, TAG_ACK, [st["sr_next"][src]], when=is_data)
+        # cumulative ack (also for duplicates below the window — re-ack),
+        # stamped with the generation it acknowledges
+        ctx.send(src, TAG_ACK, [st["sr_next"][src], st["st_epoch"][src]],
+                 when=is_data)
     else:
         shape = (W,) if V == 1 else (W, V)
         vals = jnp.zeros(shape, jnp.int32)
         deliver = jnp.zeros((W,), bool)
 
     # ---- ACK: slide the send window ------------------------------------
-    is_ack = tag == TAG_ACK
     if needed(is_ack):
         cum = payload[0]
         st["sx_base"] = st["sx_base"].at[src].set(

@@ -40,7 +40,8 @@ HEADER_WORDS = 3  # kind, method_tag, call_id
 
 def streaming_state(n_nodes: int, window: int = 4, body_words: int = 2):
     """Stream-fabric state sized for framed RPC items. Requires
-    cfg.payload_words >= 1 + HEADER_WORDS + body_words (seq + frame)."""
+    cfg.payload_words >= 2 + HEADER_WORDS + body_words (seq + epoch +
+    frame — the r19 incarnation stamp widened the transport by a word)."""
     return stream.stream_state(n_nodes, window,
                                item_words=HEADER_WORDS + body_words)
 
@@ -87,14 +88,16 @@ def reply(ctx: Ctx, st, dst, call_id, body=(), *, method=0, when=True):
                        _frame(K_REPLY, method, call_id, body, V), when=when)
 
 
-def on_stream(ctx: Ctx, st, src, tag, payload):
+def on_stream(ctx: Ctx, st, src, tag, payload, *, epoch_guard=True):
     """Feed a received message through transport + framing.
 
     Returns (kinds[W], methods[W], call_ids[W], bodies[W, B], mask[W]):
     the frames newly deliverable IN ORDER this event. Safe to call
     unconditionally; non-stream tags yield an all-False mask.
-    """
-    vals, mask = stream.on_message(ctx, st, src, tag, payload)
+    `epoch_guard` passes through to the transport's incarnation check
+    (net/stream.py r19)."""
+    vals, mask = stream.on_message(ctx, st, src, tag, payload,
+                                   epoch_guard=epoch_guard)
     return (vals[:, 0], vals[:, 1], vals[:, 2],
             vals[:, HEADER_WORDS:], mask)
 
@@ -106,7 +109,8 @@ def tick(ctx: Ctx, st, peers, *, when=True):
         stream.retransmit(ctx, st, p, when=when)
 
 
-def reset_peer(st, peer, *, when=True):
+def reset_peer(st, peer, *, when=True, epoch=None):
     """Tear down the stream fabric to a (restarted) peer — outstanding
-    calls die with the connection, as when a tonic channel breaks."""
-    stream.reset_peer(st, peer, when=when)
+    calls die with the connection, as when a tonic channel breaks.
+    `epoch` passes through to the transport's incarnation counter."""
+    stream.reset_peer(st, peer, when=when, epoch=epoch)

@@ -151,6 +151,63 @@ def torn_write_kill(at, node, down=ms(500), sc: Scenario | None = None):
     return sc
 
 
+# ---------------------------------------------------------------------------
+# connection-fault recipes (r19, DESIGN §20): TCP-grade transport faults —
+# the fault shapes madsim's NetSim::reset_node injects that datagram-level
+# loss/latency cannot express. Knob-plane scenarios like everything else,
+# so the fuzzer mutates times/targets/rates for free (fault_perturb).
+# ---------------------------------------------------------------------------
+
+def conn_reset_storm(rounds: int = 3, first=ms(300), period=ms(450),
+                     node=None, among=None, sc: Scenario | None = None):
+    """Repeatedly tear down every connection touching the target (a
+    random pool member when `node` is None) — the reset_node churn
+    regime: established sessions die mid-pipeline on BOTH sides, and
+    whatever was in flight belongs to a dead incarnation. Sound
+    transports re-handshake onto a fresh epoch; unsound ones accept the
+    dead incarnation's retransmits into the new window."""
+    sc = sc or Scenario()
+    for t in range(rounds):
+        if node is None:
+            sc.at(first + period * t).reset_peer_random(among=among)
+        else:
+            sc.at(first + period * t).reset_peer(node)
+    return sc
+
+
+def retransmit_storm(at, rate: float, until, node=None, among=None,
+                     sc: Scenario | None = None):
+    """Duplicate-delivery window: every datagram dispatched at the target
+    is redelivered with probability `rate` (duplicates can duplicate
+    again — a geometric storm) from `at` until `until` — the regime a
+    Go-Back-N transport's exactly-once claim must survive."""
+    sc = sc or Scenario()
+    if node is None:
+        sc.at(at).set_dup_random(rate, among=among)
+        sc.at(until).set_dup_random(0, among=among)
+    else:
+        sc.at(at).set_dup(node, rate)
+        sc.at(until).set_dup(node, 0)
+    return sc
+
+
+def half_open_churn(node, rounds: int = 2, first=ms(300), period=ms(600),
+                    down=ms(150), sc: Scenario | None = None):
+    """Kill/restart churn that leaves HALF-OPEN connections behind — a
+    kill alone deliberately does NOT tear the survivors' conn state
+    (conn.py: only a reset does), so peers keep talking to an
+    ESTABLISHED ghost until a reset-peer pulse at the end of each round
+    finally tears both sides down. Composes with gray_failure like
+    every recipe."""
+    sc = sc or Scenario()
+    for t in range(rounds):
+        t0 = first + period * t
+        sc.at(t0).kill(node)
+        sc.at(t0 + down).restart(node)
+        sc.at(t0 + down + ms(100)).reset_peer(node)
+    return sc
+
+
 def gray_failure(at, until, group=(0,), skew: int = 256,
                  disk_latency=ms(20), direction: int = 0,
                  sc: Scenario | None = None):

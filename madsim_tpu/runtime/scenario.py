@@ -31,15 +31,21 @@ from ..core import types as T
 # covers the classic lifecycle/partition/clog chaos (and a faultless
 # script); the triage accounting contract adds an explicit "base" class
 # for rows it cannot see at all — never a silent "other".
-RECIPE_FAMILIES = ("torn_write", "slow_disk", "clock_skew",
+RECIPE_FAMILIES = ("conn_fault", "torn_write", "slow_disk", "clock_skew",
                    "asym_partition", "loss_latency", "none")
 
 
 def row_recipe_class(op: int, torn: bool = False) -> str:
     """The recipe family one scenario row encodes. OP_SET_DISK splits on
     its torn flag (a torn-armed disk row is the torn_write_kill recipe's
-    signature; a plain latency stall is slow_disk)."""
+    signature; a plain latency stall is slow_disk). The r19 connection-
+    fault ops (reset-peer teardown, duplicate-delivery storm) class as
+    conn_fault — first in precedence, so a mutant that kept its
+    connection fault reads as the conn recipe even while gray rows
+    stay on."""
     from ..core import types as _T
+    if op in (_T.OP_RESET_PEER, _T.OP_SET_DUP):
+        return "conn_fault"
     if op == _T.OP_SET_DISK:
         return "torn_write" if torn else "slow_disk"
     if op == _T.OP_SET_SKEW:
@@ -125,6 +131,7 @@ class Scenario:
         T.OP_HEAL: "heal", T.OP_PARTITION: "partition", T.OP_HALT: "halt",
         T.OP_PARTITION_ONEWAY: "partition_oneway",
         T.OP_SET_SKEW: "set_skew", T.OP_SET_DISK: "set_disk",
+        T.OP_RESET_PEER: "reset_peer", T.OP_SET_DUP: "set_dup",
     }
 
     @staticmethod
@@ -142,7 +149,7 @@ class Scenario:
         # (builder rows carry them in payload_tail; KnobPlan.to_scenario
         # rows bake them into the payload's end — the pool decode below
         # must not read value bits as phantom pool members)
-        n_tail = {T.OP_SET_SKEW: 1, T.OP_SET_DISK: 2}
+        n_tail = {T.OP_SET_SKEW: 1, T.OP_SET_DISK: 2, T.OP_SET_DUP: 1}
         for r in self.rows:
             name = self._OP_NAMES.get(r.op, f"op{r.op}")
             if r.node == T.NODE_RANDOM:
@@ -165,13 +172,15 @@ class Scenario:
                 tgt = ""
                 extra = (f" group_a={self._unpack_members(r.payload)}"
                          f" dir={'in' if r.src & 1 else 'out'}")
-            elif r.op in (T.OP_SET_SKEW, T.OP_SET_DISK):
+            elif r.op in (T.OP_SET_SKEW, T.OP_SET_DISK, T.OP_SET_DUP):
                 # builder rows keep values in payload_tail; rows round-
                 # tripped through KnobPlan.to_scenario carry the full
                 # payload with the values already right-aligned — the
                 # tail IS the payload's tail either way
                 vals = [0, 0] + list(r.payload_tail or r.payload)
                 extra = (f" skew={vals[-1]}" if r.op == T.OP_SET_SKEW
+                         else f" rate={vals[-1] / 1e6:g}"
+                         if r.op == T.OP_SET_DUP
                          else f" lat={vals[-1]}us torn={vals[-2]}")
             elif r.op == T.OP_SET_LOSS:
                 tgt = ""
@@ -265,6 +274,20 @@ class Scenario:
                     at.set_disk_random(lat, torn=torn, among=pool)
                 else:
                     at.set_disk(node, lat, torn=torn)
+            elif op == T.OP_SET_DUP:
+                node, pool, rest = target(rest)
+                rate = float(re.match(r"rate=([\d.e+-]+)$", rest).group(1))
+                if node == T.NODE_RANDOM:
+                    at.set_dup_random(rate, among=pool)
+                else:
+                    at.set_dup(node, rate)
+            elif op == T.OP_RESET_PEER:
+                node, pool, _ = target(rest)
+                if node == T.NODE_RANDOM:
+                    at._add(op, T.NODE_RANDOM,
+                            payload=_At._pool(pool) if pool else ())
+                else:
+                    at.reset_peer(node)
             else:               # node-lifecycle / clog ops
                 node, pool, _ = target(rest)
                 method = {
@@ -459,6 +482,37 @@ class _At:
         return self._add(T.OP_SET_DISK, T.NODE_RANDOM,
                          payload=self._pool(among),
                          payload_tail=(int(bool(torn)), int(latency)))
+
+    def reset_peer(self, node):
+        """Tear down every established connection/stream touching `node`,
+        on BOTH sides, and bump the incarnation epochs (r19 — the madsim
+        NetSim::reset_node parity): in-flight segments and RSTs from the
+        torn incarnation are rejected by whatever connection comes next.
+        Inert for models without the net/conn+stream state leaves."""
+        return self._add(T.OP_RESET_PEER, node)
+
+    def reset_peer_random(self, among=None):
+        """Reset-peer a random node (pool-restricted like kill_random)."""
+        return self._add(T.OP_RESET_PEER, T.NODE_RANDOM,
+                         payload=self._pool(among))
+
+    def set_dup(self, node, rate: float):
+        """Set `node`'s duplicate-delivery rate (r19): each MESSAGE
+        dispatched at the node is delivered one more time with this
+        probability (fresh latency draw, byte-identical payload — the
+        retransmit-storm regime; duplicates can duplicate again).
+        Clipped to DUP_RATE_CAP (0.9) at application; `set_dup(n, 0)`
+        restores exactly-once datagram delivery."""
+        return self._add(T.OP_SET_DUP, node,
+                         payload_tail=(int(rate * 1e6),))
+
+    def set_dup_random(self, rate: float, among=None):
+        """Dup-storm a random node (pool-restricted like kill_random);
+        the rate rides the tail payload word, so pool and value
+        coexist."""
+        return self._add(T.OP_SET_DUP, T.NODE_RANDOM,
+                         payload=self._pool(among),
+                         payload_tail=(int(rate * 1e6),))
 
     def heal(self):
         """Clear all clogs/partitions (one-way cuts included)."""

@@ -52,13 +52,19 @@ N_MUT_OPS = len(OP_NAMES)
 # _apply_super: the random-target pool packing); everything else keeps its
 # base node. The r17 per-node fault ops ride along: the fuzzer may move
 # WHICH node's clock drifts or disk stalls, pool-confined like kills.
+# The r19 connection-fault ops join the same way: the fuzzer may move
+# WHOSE connections get torn or which node's datagrams duplicate.
 _NODE_OPS = (T.OP_KILL, T.OP_RESTART, T.OP_PAUSE, T.OP_RESUME,
              T.OP_CLOG_NODE, T.OP_UNCLOG_NODE,
-             T.OP_SET_SKEW, T.OP_SET_DISK)
-# r17 gray-failure value/flag knobs: rows whose TAIL payload word carries
-# a bounded value (skew rate / disk latency), whose payload[-2] carries
-# the torn flag, and whose src carries the one-way-cut direction
-_VAL_OPS = (T.OP_SET_SKEW, T.OP_SET_DISK)
+             T.OP_SET_SKEW, T.OP_SET_DISK,
+             T.OP_RESET_PEER, T.OP_SET_DUP)
+# r17/r19 fault value/flag knobs: rows whose TAIL payload word carries
+# a bounded value (skew rate / disk latency / dup-delivery rate), whose
+# payload[-2] carries the torn flag, and whose src carries the
+# one-way-cut direction. OP_SET_DUP rides the existing fault_perturb
+# havoc operator through val_ok — zero per-round recompiles, zero new
+# knob-vector keys (the store schema moves via the simconfig-v6 bump).
+_VAL_OPS = (T.OP_SET_SKEW, T.OP_SET_DISK, T.OP_SET_DUP)
 # rows that must never move, drop, or duplicate: HALT carries the
 # time-limit contract, INIT rows interact with the template's deferred-boot
 # bookkeeping (runtime.py _build_template)
@@ -137,7 +143,9 @@ class KnobPlan:
         torn_ok = (op == T.OP_SET_DISK) & (cfg.payload_words >= 2)
         val_lo = np.where(op == T.OP_SET_SKEW, -T.SKEW_CAP, 0)
         val_hi = np.where(op == T.OP_SET_SKEW, T.SKEW_CAP,
-                          np.where(op == T.OP_SET_DISK, T.DISK_LAT_CAP, 0))
+                          np.where(op == T.OP_SET_DISK, T.DISK_LAT_CAP,
+                                   np.where(op == T.OP_SET_DUP,
+                                            T.DUP_RATE_CAP, 0)))
         return KnobPlan(
             n_init=n_init, R=R, D=D, N=N, payload_words=cfg.payload_words,
             jitter_gate=cfg.net.op_jitter_max > 0,

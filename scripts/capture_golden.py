@@ -1,20 +1,29 @@
-"""Freeze per-leaf golden digests for the r17 equivalence contract.
+"""Freeze per-leaf golden digests for an equivalence contract.
 
 Run this ONLY at an engine state whose trajectories are the truth being
-gated (it was run at r16 HEAD before the gray-failure plane landed).
-Re-running it after an engine change would overwrite the evidence with
-whatever the current tree produces — the test would then prove nothing.
+gated. Each fault-plane PR captures its own harness module at the HEAD
+it gates against:
 
-    JAX_PLATFORMS=cpu python scripts/capture_golden.py
+    # r17 contract (captured at r16 HEAD, before the gray-failure plane)
+    JAX_PLATFORMS=cpu python scripts/capture_golden.py _grayfail_golden
+
+    # r19 contract (captured at r18 HEAD, before the connection-fault plane)
+    JAX_PLATFORMS=cpu python scripts/capture_golden.py _connfault_golden
+
+Re-running a capture after the gated engine change landed would
+overwrite the evidence with whatever the current tree produces — the
+test would then prove nothing.
 """
 
+import importlib
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import _grayfail_golden as g  # noqa: E402
+module = sys.argv[1] if len(sys.argv) > 1 else "_grayfail_golden"
+g = importlib.import_module(module)
 
 doc = g.capture()
 n = sum(len(v) for w in doc.values() for v in w.values())

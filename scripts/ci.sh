@@ -79,6 +79,13 @@ case "$tier" in
     # a torn-write fuzz campaign must open causal-fingerprint crash
     # buckets with replayable (seed, knobs) handles
     python bench.py --grayfail-smoke
+    # connection-fault smoke: OP_RESET_PEER must tear conn/stream state
+    # on BOTH sides (vs the kill's deliberate half-open survivor), the
+    # minipg exactly-once flagship must survive the reset+dup storm with
+    # incarnation guards on AND crash fingerprint-exact-replayably with
+    # them compiled to the pre-r19 behavior, and a dup-storm fuzz
+    # campaign must open causal buckets whose handles replay red
+    python bench.py --conn-smoke
     # campaign-triage smoke: a 2-worker campaign must snapshot
     # byte-stably into the triage/ history, a planted bucket must diff
     # as exactly one `new` entry with its torn_write recipe

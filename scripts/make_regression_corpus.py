@@ -2,15 +2,18 @@
 regression_corpus/) — the OSS-Fuzz-style gate `bench.py
 --regression-smoke` replays in ci.sh fast.
 
-Runs a small DETERMINISTIC durable fuzz campaign on the gray-failure
-flagship and freezes the resulting corpus dir (entries + causal-
-fingerprint crash buckets + worker state) plus a REGRESSION.json
-sidecar naming the runtime factory and replay budget. Re-run this ONLY
-when the store signature legitimately moves (a new knob dimension, a
-structural change to the flagship) — the whole point of the gate is
-that buckets keep reproducing across unrelated changes.
+Runs a small DETERMINISTIC durable fuzz campaign per flagship regime and
+freezes the resulting corpus dir (entries + causal-fingerprint crash
+buckets + worker state) plus a REGRESSION.json sidecar naming the
+runtime factory and replay budget. Re-run this ONLY when the store
+signature legitimately moves (a new knob dimension, a structural change
+to a flagship) — the whole point of the gate is that buckets keep
+reproducing across unrelated changes. (Last re-frozen at r19: the
+simconfig-v6 / knob-schema bump rejects pre-r19 corpus dirs with
+StoreMismatch, so both campaigns were regenerated; the grayfail
+trajectories themselves are bit-identical to the r17 freeze.)
 
-    JAX_PLATFORMS=cpu python scripts/make_regression_corpus.py
+    JAX_PLATFORMS=cpu python scripts/make_regression_corpus.py [name ...]
 """
 
 import json
@@ -25,26 +28,54 @@ import bench  # noqa: E402
 from madsim_tpu import fuzz  # noqa: E402
 from madsim_tpu.service.store import CorpusStore  # noqa: E402
 
-DEST = os.path.join(REPO, "tests", "data", "regression_corpus",
-                    "grayfail_mix")
-MAX_STEPS = 30_000
+BASE = os.path.join(REPO, "tests", "data", "regression_corpus")
 
-shutil.rmtree(DEST, ignore_errors=True)
-rt = bench._make_grayfail_runtime("mix")
-res = fuzz(rt, max_steps=MAX_STEPS, batch=64, max_rounds=4, dry_rounds=5,
-           chunk=512, corpus_dir=DEST, rng_seed=1)
-store = CorpusStore(DEST, create=False)
-keys = store.bucket_keys()
-assert keys, "campaign found no crash buckets — nothing to gate on"
-with open(os.path.join(DEST, "REGRESSION.json"), "w") as f:
-    json.dump(dict(
+CAMPAIGNS = {
+    # the r17 gray-failure flagship: Percolator-lite under the composed
+    # fault mix (asym cut, drifting clocks, slow disk, torn kill)
+    "grayfail_mix": dict(
         factory="bench:_make_grayfail_runtime",
         factory_kwargs=dict(recipe="mix"),
-        dup_slots=2,
-        max_steps=MAX_STEPS,
-        buckets=keys,
-        note=("frozen by scripts/make_regression_corpus.py; replayed "
-              "by bench.py --regression-smoke in ci.sh fast"),
-    ), f, indent=1)
-print(f"{DEST}: {len(store.entry_names())} entries, "
-      f"{len(keys)} buckets: {keys}")
+        max_steps=30_000, batch=64, max_rounds=4, rng_seed=1),
+    # the r19 connection-fault flagship: minipg exactly-once transactions
+    # with incarnation guards compiled to the pre-r19 behavior, under the
+    # reset+dup storm (the honest red control — these buckets ARE the
+    # stale-segment corruptions the guard exists to prevent)
+    "connfault_mix": dict(
+        factory="bench:_make_connfault_runtime",
+        factory_kwargs=dict(recipe="mix"),
+        max_steps=30_000, batch=64, max_rounds=4, rng_seed=1),
+}
+
+names = sys.argv[1:] or sorted(CAMPAIGNS)
+for name in names:
+    spec = CAMPAIGNS[name]
+    dest = os.path.join(BASE, name)
+    shutil.rmtree(dest, ignore_errors=True)
+    mod, fn = spec["factory"].split(":")
+    rt = getattr(bench, fn)(**spec["factory_kwargs"])
+    res = fuzz(rt, max_steps=spec["max_steps"], batch=spec["batch"],
+               max_rounds=spec["max_rounds"],
+               dry_rounds=spec["max_rounds"] + 1,
+               chunk=512, corpus_dir=dest, rng_seed=spec["rng_seed"])
+    store = CorpusStore(dest, create=False)
+    keys = store.bucket_keys()
+    assert keys, f"{name}: campaign found no crash buckets to gate on"
+    # freeze the store MINIMAL: the triage/ subdir (ROWS.json, snapshots)
+    # is derived state the r18+ fuzz writes on open — the committed
+    # fixture stays rowless so tests/test_triage.py can exercise the
+    # rows-unknown attribution fallback against it, and triage output
+    # never bloats the repo
+    shutil.rmtree(os.path.join(dest, "triage"), ignore_errors=True)
+    with open(os.path.join(dest, "REGRESSION.json"), "w") as f:
+        json.dump(dict(
+            factory=spec["factory"],
+            factory_kwargs=spec["factory_kwargs"],
+            dup_slots=2,
+            max_steps=spec["max_steps"],
+            buckets=keys,
+            note=("frozen by scripts/make_regression_corpus.py; replayed "
+                  "by bench.py --regression-smoke in ci.sh fast"),
+        ), f, indent=1)
+    print(f"{dest}: {len(store.entry_names())} entries, "
+          f"{len(keys)} buckets: {keys}")

@@ -1,0 +1,40 @@
+"""Shared harness for the r19 bit-identical-when-disabled contract.
+
+The connection-fault plane (r19) added engine machinery — reset-peer
+conn/stream teardown, per-node duplicate-delivery rate — that is
+DYNAMIC: always compiled in, masked to identity at the zero defaults.
+The contract is that a scenario using none of the new ops produces
+trajectories BIT-IDENTICAL to r18, leaf for leaf, chunked and fused.
+
+Same frozen workload builders as the r17 harness (_grayfail_golden —
+they are the canonical engine-equivalence workloads, deliberately
+conn/stream-free so the library-level wire-format change cannot touch
+them); digests were captured AT r18 HEAD by scripts/capture_golden.py
+into tests/data/golden_r18_leaves.json, before any r19 engine change
+landed. Every r18 leaf must still exist and hash identically — the
+only new leaf the r19 plane may add is `.dup_rate`.
+"""
+
+from __future__ import annotations
+
+import os
+
+import _grayfail_golden as _g
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "data",
+                           "golden_r18_leaves.json")
+
+# the frozen definition is shared with the r17 harness — one set of
+# engine workloads, two captured truths (r16 and r18)
+RUNS = _g.RUNS
+BUILDERS = _g.BUILDERS
+leaf_digests = _g.leaf_digests
+run_workload = _g.run_workload
+
+
+def capture(path: str = GOLDEN_PATH) -> dict:
+    return _g.capture(path)
+
+
+def load_golden(path: str = GOLDEN_PATH) -> dict:
+    return _g.load_golden(path)

@@ -59,7 +59,8 @@ class Client(Program):
 
     def on_message(self, ctx, src, tag, payload):
         st = dict(ctx.state)
-        accepted, established, was_rst = conn.on_message(ctx, st, src, tag)
+        accepted, established, was_rst = conn.on_message(ctx, st, src, tag,
+                                                         payload)
         st["established"] = st["established"] + established
         st["refused"] = st["refused"] + (was_rst & (ctx.node == 2))
 

@@ -42,9 +42,11 @@ class TestEquivalenceR16:
         # before any r17 engine change: every r16 leaf must still hash
         # identically, chunked and fused. New leaves are allowed only by
         # name: r17's gray-failure plane (skew/disk_lat/torn, gated by
-        # simconfig-v5) and r18's hash_base (the frozen seed key — a
+        # simconfig-v5), r18's hash_base (the frozen seed key — a
         # constant that consumes nothing, which is exactly why every
-        # OTHER leaf must still match r16 bit for bit).
+        # OTHER leaf must still match r16 bit for bit), and r19's
+        # dup_rate (connection-fault plane, simconfig-v6 — its own
+        # golden gate lives in tests/test_connfault.py vs r18 truth).
         gold = golden.load_golden()[workload]
         got = golden.run_workload(workload)
         for runner in ("run", "run_fused"):
@@ -55,7 +57,7 @@ class TestEquivalenceR16:
             assert not diff, (runner, diff)
             new = set(got[runner]) - set(gold[runner])
             assert new == {".skew", ".disk_lat", ".torn",
-                           ".hash_base"}, new
+                           ".hash_base", ".dup_rate"}, new
 
 
 # ---------------------------------------------------------------------------
@@ -477,6 +479,9 @@ class TestCheckpointMigration:
         with pytest.raises(ValueError, match="leaves"):
             checkpoint.load(p2, st)
 
-    def test_signature_is_v5(self):
+    def test_signature_is_current(self):
+        # r17 introduced v5; the r19 connection-fault plane bumped it to
+        # v6 (dup_rate leaf + conn-fault knob rows) — test_connfault.py
+        # owns the authoritative version assertion
         cfg = SimConfig(n_nodes=2)
-        assert cfg.structural_signature()[0] == "simconfig-v5"
+        assert cfg.structural_signature()[0] == "simconfig-v6"
