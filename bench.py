@@ -3100,6 +3100,213 @@ def _shape_sweep_mode():
     print(json.dumps(out))
 
 
+def _tt_smoke_mode():
+    """--tt-smoke: seconds-scale time-travel-replay self-test for CI
+    (wired into scripts/ci.sh fast):
+
+      1. a crash recorded with a 4-slot ring (wrapped, chain truncated)
+         must replay from a harvested checkpoint to a COMPLETE
+         (`truncated=False`) causal chain, bit-stably twice, with the
+         live truncated chain a suffix of it and the fingerprints
+         bucket-compatible (deepest-common-suffix);
+      2. checkpoint fidelity: a lane re-seeded from a harvest must
+         finish fingerprint-identical to the uninterrupted run on the
+         fused runner, and the replayed window trace must export;
+      3. the divergence microscope must name the SAME first divergent
+         dispatch on a re-run of the same pair.
+
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import os as _os
+    import tempfile as _tempfile
+
+    import numpy as _np
+
+    from madsim_tpu import CheckpointLog, divergence_report, explain_crash
+    from madsim_tpu import seed_batch_from
+    from madsim_tpu.obs.causal import causal_fingerprint, fingerprints_match
+
+    rt = _make_crashrich_runtime("wal_kv", trace_cap=4)
+    seeds = _np.arange(24, dtype=_np.uint32)
+
+    # uninterrupted control (fused) vs harvested run (chunked): the
+    # r20 zero-cost/equivalence contract — harvesting must not perturb
+    control = rt.run_fused(rt.init_batch(seeds), 30_000, 16)
+    cfp = rt.fingerprints(control)
+    log = CheckpointLog()
+    state, _ = rt.run(rt.init_batch(seeds), 30_000, 16,
+                      ckpt_every=32, ckpt_log=log)
+    assert (rt.fingerprints(state) == cfp).all(), \
+        "harvesting perturbed the trajectories"
+    print(f"--tt-smoke: harvested {len(log)} checkpoints, trajectories "
+          "bit-identical to the unharvested fused run", file=sys.stderr)
+
+    # fidelity: re-seed a crashed lane's mid-flight checkpoint and
+    # finish — fingerprint-identical to the uninterrupted lane
+    crashed = _np.nonzero(_np.asarray(state.crashed))[0]
+    steps = _np.asarray(state.steps)
+    assert len(crashed), "tt smoke workload found no crash"
+    lane = int(crashed[0])
+    ck = log.nearest(lane)
+    assert ck is not None and ck.steps > 0
+    child = rt.run_fused(seed_batch_from(ck, 2), 30_000, 16)
+    assert (rt.fingerprints(child) == cfp[lane]).all(), \
+        "checkpoint continuation diverged from the parent lane"
+    print(f"--tt-smoke: lane {lane} re-seeded from step {ck.steps} "
+          "continues fingerprint-identical", file=sys.stderr)
+
+    # the time-travel chain: live truncated -> replayed complete,
+    # bit-stable twice, bucket-compatible with the live observation
+    lane = next((int(l) for l in crashed
+                 if explain_crash(state, int(l))["truncated"]
+                 and steps[l] > 40), None)
+    assert lane is not None, "no wrap-truncated crash chain to replay"
+    live = explain_crash(state, lane)
+    tdir = _tempfile.mkdtemp(prefix="tt_smoke_")
+    tpath = _os.path.join(tdir, "window.trace.json")
+    full = explain_crash(state, lane, replay=True, rt=rt, ckpts=log,
+                         export_trace=tpath)
+    full2 = explain_crash(state, lane, replay=True, rt=rt, ckpts=log)
+    assert not full["truncated"] and full["replayed"], full.keys()
+    assert full["chain"] == full2["chain"], \
+        "time-travel chain not bit-stable across replays"
+    assert full["chain"][-len(live["chain"]):] == live["chain"], \
+        "live truncated chain is not a suffix of the replayed chain"
+    assert fingerprints_match(causal_fingerprint(full),
+                              causal_fingerprint(live)), \
+        "replayed-complete chain left its truncated sibling's bucket"
+    assert _os.path.getsize(tpath) > 0
+    print(f"--tt-smoke: lane {lane} chain {len(live['chain'])} records "
+          f"truncated -> {len(full['chain'])} records complete "
+          f"(replayed from step {full['from_step']}; window trace "
+          "exported)", file=sys.stderr)
+
+    # divergence microscope: deterministic first divergent dispatch
+    r1 = divergence_report(rt, 3, 5, max_steps=20_000, chunk=512)
+    r2 = divergence_report(rt, 3, 5, max_steps=20_000, chunk=512)
+    assert r1["diverged"] and r1["first"] is not None
+    assert r1["first"] == r2["first"], \
+        "divergence microscope named a different dispatch on re-run"
+    f = r1["first"]
+    print(f"--tt-smoke: microscope names first divergent dispatch "
+          f"step={f['step']} a=(node {f['a']['node']} kind "
+          f"{f['a']['kind']}) b=(node {f['b']['node']} kind "
+          f"{f['b']['kind']}) [bound={r1['bound']}], stable on re-run",
+          file=sys.stderr)
+    print(json.dumps({"metric": "tt_smoke", "ok": True,
+                      "checkpoints": len(log),
+                      "chain_live": len(live["chain"]),
+                      "chain_full": len(full["chain"]),
+                      "first_divergent_step": f["step"]}))
+
+
+def _tt_ab_mode():
+    """--mode tt_ab: the two costs of the time-travel plane, measured.
+
+    (a) HARVEST OVERHEAD — the obs_ab protocol on the chunked runner
+        (the path whose existing syncs the harvest rides): B=512 tiny
+        workload, `ckpt_every` on vs off, interleaved min-of-reps. The
+        bar is <=3%: periodic owned host copies at chunk boundaries
+        must be noise next to the sweep itself.
+    (b) WINDOW REPLAY vs FROM-SCRATCH — on a LONG trajectory, recover
+        a complete crash chain (i) by window replay from the last
+        harvested checkpoint and (ii) by re-running from t=0 with a
+        full-size ring. Window replay must be strictly cheaper —
+        that's the point of checkpoints.
+
+    Writes BENCH_tt_ab_<platform>.json next to this file."""
+    _preflight_or_cpu("--tt-ab")
+    import jax
+
+    import numpy as _np
+
+    from madsim_tpu import CheckpointLog
+
+    platform = jax.devices()[0].platform
+    B, steps, chunk, reps = 512, 2048, 256, 9
+    rt = _make_light_runtime(trace_cap=0)
+    seeds = _np.arange(B)
+    out = {"metric": "tt_ab", "platform": platform, "batch": B,
+           "steps": steps, "chunk": chunk, "reps": reps,
+           "note": ("(a) obs_ab protocol on the CHUNKED runner — the "
+                    "harvest rides its existing per-chunk syncs; "
+                    "ckpt_every=1024 at 2048 steps = 2 mid-flight "
+                    "harvests + the entry snapshot, each an owned host "
+                    "copy of the full B=512 batch. (b) wall-clock of "
+                    "re-executing the final 4096-dispatch window of a "
+                    "16k-dispatch trajectory under a full-fidelity "
+                    "ring: from the last harvested checkpoint (ring "
+                    "sized to the window) vs from t=0 (ring sized to "
+                    "the whole trajectory); both land on the identical "
+                    "fingerprint, speedup ~ target/(target-ckpt) minus "
+                    "fixed derive/seed costs.")}
+
+    def run_once(ck):
+        state = rt.init_batch(seeds)
+        jax.block_until_ready(state.now)
+        t0 = time.perf_counter()
+        fin, _ = rt.run(state, steps, chunk,
+                        **({"ckpt_every": 1024,
+                            "ckpt_log": CheckpointLog()} if ck else {}))
+        jax.block_until_ready(fin.now)
+        return time.perf_counter() - t0
+
+    run_once(False)          # warm the executable
+    best = {"off": float("inf"), "ckpt": float("inf")}
+    for _ in range(reps):
+        best["off"] = min(best["off"], run_once(False))
+        best["ckpt"] = min(best["ckpt"], run_once(True))
+    eps = {k: B * steps / v for k, v in best.items()}
+    out["harvest"] = {k: round(v, 1) for k, v in eps.items()}
+    out["overhead_ckpt"] = round(eps["off"] / eps["ckpt"] - 1, 4)
+    print(f"--tt-ab: harvest overhead {out['overhead_ckpt']:+.2%} "
+          f"(off {eps['off']:,.0f} vs ckpt {eps['ckpt']:,.0f} "
+          "seed-events/s)", file=sys.stderr)
+
+    # (b) window replay vs from-scratch on a LONG trajectory: lanes
+    # that never halt, 16k dispatches, harvested every 4096; the
+    # window of interest is the last 4096-dispatch stretch. Replaying
+    # THAT window from the nearest checkpoint (ring sized to the
+    # window) must beat re-executing all 16k from t=0 with a
+    # full-trajectory ring — the whole point of harvesting.
+    from madsim_tpu import replay_window
+    from madsim_tpu.obs.timetravel import init_checkpoint
+
+    target, every = 16_384, 4096
+    log = CheckpointLog()
+    state, _ = rt.run(rt.init_batch(_np.arange(8)), target, chunk,
+                      ckpt_every=every, ckpt_log=log)
+    ck = log.nearest(0, step=target - 1)
+    ck0 = init_checkpoint(rt, 0)
+    # warm both derived executables (distinct ring buckets), check
+    # the two paths land on the identical mid-flight state
+    a = replay_window(rt, ck, until_step=target, chunk=chunk)
+    b = replay_window(rt, ck0, until_step=target, chunk=chunk)
+    assert a["fingerprint"] == b["fingerprint"], \
+        "window and from-scratch replays disagree"
+    t_win = t_scratch = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        replay_window(rt, ck, until_step=target, chunk=chunk)
+        t_win = min(t_win, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        replay_window(rt, ck0, until_step=target, chunk=chunk)
+        t_scratch = min(t_scratch, time.perf_counter() - t0)
+    out["replay"] = dict(
+        target_step=target, ckpt_step=int(ck.steps),
+        window_s=round(t_win, 4), from_scratch_s=round(t_scratch, 4),
+        speedup=round(t_scratch / t_win, 2))
+    print(f"--tt-ab: window replay {t_win*1e3:.1f}ms from step "
+          f"{ck.steps} vs from-scratch {t_scratch*1e3:.1f}ms to step "
+          f"{target} — {out['replay']['speedup']}x", file=sys.stderr)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_tt_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
 def main():
     # `--mode X` is accepted as an alias for `--X` (dashes for
     # underscores), so `bench.py --mode fused_ab` and `bench.py
@@ -3120,11 +3327,18 @@ def main():
                  "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
                  "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke",
                  "--lat-ab", "--lat-smoke", "--grayfail-smoke",
-                 "--regression-smoke", "--triage-smoke", "--conn-smoke"}
+                 "--regression-smoke", "--triage-smoke", "--conn-smoke",
+                 "--tt-ab", "--tt-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
         sys.argv.append(flag)
+    if "--tt-smoke" in sys.argv:
+        _tt_smoke_mode()
+        return
+    if "--tt-ab" in sys.argv:
+        _tt_ab_mode()
+        return
     if "--analyze-smoke" in sys.argv:
         _analyze_smoke_mode()
         return

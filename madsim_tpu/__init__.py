@@ -11,7 +11,8 @@ program and shard across chips with jax.sharding.
 """
 
 from .core.api import Ctx, Program
-from .core.state import SimState
+from .core.state import (CheckpointMismatch, LaneCheckpoint, SimState,
+                         checkpoint_lane, seed_batch_from)
 from .core.types import (
     CRASH_DEADLOCK,
     CRASH_INVARIANT,
@@ -30,16 +31,21 @@ from .core.extension import Extension
 from .analyze import (confirm_race, find_races, lint_runtime, scan_races)
 from .harness.determinism import find_divergence
 from .obs import (
+    CheckpointLog,
     JsonlObserver,
     ProgressObserver,
+    ReplayDivergence,
     SweepObserver,
+    divergence_report,
     explain_crash,
     export_chrome_trace,
     export_profile_trace,
     format_latency,
     format_profile,
+    full_chain_replay,
     latency_summary,
     profile_summary,
+    replay_window,
     ring_records,
 )
 from .harness.minimize import minimize_scenario
@@ -77,4 +83,7 @@ __all__ = [
     "triage_snapshot", "triage_diff", "audit_buckets",
     "lint_runtime", "find_races", "confirm_race", "scan_races",
     "detsan_check", "DetSanFailure",
+    "LaneCheckpoint", "CheckpointMismatch", "checkpoint_lane",
+    "seed_batch_from", "CheckpointLog", "replay_window",
+    "full_chain_replay", "divergence_report", "ReplayDivergence",
 ]

@@ -138,7 +138,8 @@ def _pair_order(recs: dict, cand: dict) -> bool | None:
 
 def confirm_race(rt, seed: int, cand: dict, *, knobs: dict | None = None,
                  plan=None, nudges=None, max_steps: int = 20_000,
-                 chunk: int = 512, base_nudge: int | None = None) -> dict:
+                 chunk: int = 512, base_nudge: int | None = None,
+                 full_chain: bool = False) -> dict:
     """Force the commuted order of one candidate pair and diff outcomes.
 
     Replays `seed` (with `knobs` applied when the candidate came from a
@@ -161,7 +162,16 @@ def confirm_race(rt, seed: int, cand: dict, *, knobs: dict | None = None,
       inconclusive  no nudge in the sweep flipped the pair (or the pair
                   left the ring window); widen `nudges`
 
-    Returns {status, nudge, repro, baseline, diff, commuted, swept}.
+    full_chain (r20): when the CONFIRMED commuted outcome is a crash,
+    re-run the (seed, knobs, nudge) handle through
+    `obs.timetravel.full_chain_replay` (ring upgraded to hold the
+    whole trajectory) and attach `chain`/`chain_complete` to the
+    result — the same hook `replay_bucket` grew, so a race bucket can
+    carry the complete causal chain of the outcome the race flips the
+    run into (`scan_races` threads it into the bucket record).
+
+    Returns {status, nudge, repro, baseline, diff, commuted, swept
+    [, chain, chain_complete]}.
     """
     if base_nudge is None:
         # the baseline must replay the OBSERVED schedule: a fuzz mutant
@@ -232,6 +242,16 @@ def confirm_race(rt, seed: int, cand: dict, *, knobs: dict | None = None,
                                 ("crashed", "crash_code", "crash_node")},
                       commuted={k: hit_rep[k] for k in
                                 ("crashed", "crash_code", "crash_node")}))
+        if full_chain and hit_rep["crashed"]:
+            from ..obs.timetravel import full_chain_replay
+            rep = full_chain_replay(
+                rt, seed=int(seed), knobs=knobs, nudge=nudge,
+                expect={k: hit_rep[k] for k in
+                        ("crashed", "crash_code", "crash_node",
+                         "fingerprint")},
+                max_steps=max_steps, chunk=chunk)
+            out["chain"] = rep["explain"]["chain"]
+            out["chain_complete"] = not rep["explain"]["truncated"]
         return out
     if commuted:
         out.update(status="benign", nudge=None, repro=None, diff=None)
@@ -294,7 +314,8 @@ def _dedupe_key(cand: dict) -> tuple:
 def scan_races(rt, seeds, max_steps: int = 20_000, chunk: int = 512,
                *, knobs: dict | None = None, plan=None, lanes=None,
                max_lanes: int = 4, max_confirm: int = 8, nudges=None,
-               buckets=None, worker_id: int = 0) -> dict:
+               buckets=None, worker_id: int = 0,
+               full_chain: bool = False) -> dict:
     """The batteries-included pass: run a seed batch with the ring on,
     harvest candidate pairs from (by default) the crashed lanes — a
     crash is where an order bug is worth the confirm budget — dedupe
@@ -333,15 +354,21 @@ def scan_races(rt, seeds, max_steps: int = 20_000, chunk: int = 512,
     for cand in list(by_key.values())[:max_confirm]:
         seed = int(seeds[cand["lane"]])
         conf = confirm_race(rt, seed, cand, knobs=knobs, plan=plan,
-                            nudges=nudges, max_steps=max_steps, chunk=chunk)
+                            nudges=nudges, max_steps=max_steps, chunk=chunk,
+                            full_chain=full_chain)
         if conf["status"] == "confirmed":
             results["confirmed"].append(conf)
             if buckets is not None:
+                # with full_chain the bucket carries the complete chain
+                # of the commuted OUTCOME (what the race flips the run
+                # into), not just the racing pair
                 key, _ = buckets.observe(
                     race_fingerprint(cand, conf["diff"]),
                     seed=seed, knobs=knobs, round_no=0,
                     worker_id=worker_id, nudge=conf["nudge"],
-                    chain=[cand["a"], cand["b"]])
+                    chain=conf.get("chain") or [cand["a"], cand["b"]],
+                    chain_truncated=(None if "chain_complete" not in conf
+                                     else not conf["chain_complete"]))
                 results["bucket_keys"].append(key)
         elif conf["status"] == "benign":
             results["benign"] += 1

@@ -18,10 +18,12 @@ event table subsumes the mailbox.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from . import types as T
@@ -451,5 +453,260 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         slo_target=jnp.asarray(cfg.slo_target, i32),
         ext=ext_state if ext_state is not None else {},
     )
+
+
+# ---------------------------------------------------------------------------
+# Lane checkpoints (r20, DESIGN §21): checkpoint ONE lane of a batched
+# state — gather its leaves into an owned host copy — and broadcast it
+# back into a fresh batch later. The snapshot/fork primitive: a lane
+# seeded back with unchanged knobs/nudge continues leaf-for-leaf
+# bit-identical to the parent lane (the step is a pure function of
+# state), and a batch of B clones forked with fresh nudges/knob deltas
+# amortizes the shared prefix (the Podracer branching-rollout shape).
+# ---------------------------------------------------------------------------
+
+# Observation planes a checkpoint may be re-seeded into a runtime with a
+# DIFFERENT observability build than it was captured under (window
+# replay upgrades the ring/profiler/latency plane mid-trajectory).
+# Each plane adapts as a UNIT: when every leaf of the plane matches the
+# target runtime's shapes/dtypes the checkpoint values are preserved
+# verbatim (the bit-identical-continuation case); when any leaf differs
+# the whole plane is re-initialized from the target runtime's template
+# (fresh empty ring, external provenance, zeroed counters) — legal
+# because the planes are observation-only (TRACE_FIELDS, DESIGN §9):
+# they never feed the replay domain, so the trajectory and its
+# fingerprint are unchanged either way. hash_base is the one
+# TRACE_FIELDS member outside the planes: it IS consumed by the replay
+# domain (ctx.hash_key) and is always carried over.
+_CKPT_PLANES = {
+    "ring": ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
+             "tr_kind", "tr_node", "tr_src", "tr_tag", "tr_parent",
+             "tr_lamport", "tr_qlen", "tr_lat"),
+    "lineage": ("ev_prov", "lamport"),
+    "sketch": ("cov_sketch", "sketch_every"),
+    "profile": ("pf_on", "pf_dispatch", "pf_busy", "pf_kill", "pf_restart",
+                "pf_qmax", "pf_drop", "pf_delay"),
+    "latency": ("lh_on", "ev_root_t", "lh_sojourn", "lh_e2e",
+                "lh_slo_miss", "slo_target"),
+}
+
+# the WORLD slice of a structural signature: the fields two runtimes
+# must agree on for a checkpoint's replay state to continue bit-
+# identically — shapes of the replay-domain leaves (n_nodes,
+# event_capacity, payload_words, table_dtype), the stats gate
+# (collect_stats changes msg_* trajectories), and the jitter gate (a
+# distinct replay domain). The OBSERVABILITY fields (trace bucket,
+# sketch_slots, profile, latency_hist, complete/root kinds) and the
+# emission_write lowering are deliberately excluded: differing there is
+# the point of window replay. Indexes into the simconfig-v6 tuple
+# (types.SimConfig.structural_signature); the version string at [0]
+# keeps the indexing honest across future signature revisions.
+_SIG_WORLD_IDX = (0, 1, 2, 3, 4, 6, 9)
+
+_LANE_CKPT_FORMAT = "madsim-lane-ckpt-r20"
+
+
+class CheckpointMismatch(ValueError):
+    """A LaneCheckpoint does not fit the target runtime's world shape
+    (the StoreMismatch analog for checkpoints): different cluster
+    size/event capacity/table dtype/model state schema, or a pre-r20
+    checkpoint file without the versioned lane-checkpoint header."""
+
+
+def _world_slice(sig) -> tuple:
+    sig = tuple(sig)
+    return tuple(sig[i] for i in _SIG_WORLD_IDX if i < len(sig))
+
+
+def checkpoint_lane(batch_state: SimState, lane: int,
+                    signature=None) -> "LaneCheckpoint":
+    """Snapshot ONE lane of a batched SimState: one gather per leaf,
+    then an owned host copy (the r8 donation discipline — the returned
+    checkpoint outlives later donated runs of the batch's buffers).
+
+    `signature` (the capturing runtime's `cfg.structural_signature()`)
+    rides along for the save/load contract and the world-shape check in
+    `seed_batch_from(rt=...)`; None skips the signature check (leaf
+    shape/dtype validation still applies)."""
+    leaf0 = jax.tree.leaves(batch_state)[0]
+    if np.ndim(leaf0) < 1:
+        raise ValueError("checkpoint_lane takes a BATCHED state "
+                         "(leading lane axis); got an unbatched pytree")
+    from ..utils.hostcopy import owned_host_copy
+    lane = int(lane)
+    lane_state = owned_host_copy(
+        jax.tree.map(lambda a: a[lane], batch_state))
+    return LaneCheckpoint(state=lane_state,
+                          steps=int(np.asarray(lane_state.steps)),
+                          signature=(tuple(signature)
+                                     if signature is not None else None))
+
+
+@dataclasses.dataclass
+class LaneCheckpoint:
+    """One lane's full simulation state, host-owned — everything the
+    step function needs to continue the trajectory (clock, key, event
+    table, node state, fault matrices, knobs/nudge) plus whatever
+    observation-plane state the capturing build carried.
+
+    `steps` is the lane's dispatch count at capture; `signature` the
+    capturing runtime's structural signature (None when captured
+    without one)."""
+
+    state: Any
+    steps: int
+    signature: tuple | None = None
+
+    # -- durable form (MIGRATION r20: versioned like the corpus store) --
+    def save(self, path: str) -> None:
+        """Write the checkpoint as an .npz with a versioned header —
+        format marker, structural signature, step count, treedef — so
+        `load` can reject mismatches cleanly instead of replaying a
+        foreign world. Pre-r20 batch snapshots (runtime/checkpoint.py)
+        carry no header and are rejected by `load`."""
+        leaves, treedef = jax.tree.flatten(self.state)
+        np.savez_compressed(
+            path,
+            __lane_ckpt__=np.frombuffer(
+                _LANE_CKPT_FORMAT.encode(), dtype=np.uint8),
+            __signature__=np.frombuffer(
+                repr(self.signature).encode(), dtype=np.uint8),
+            __steps__=np.asarray(int(self.steps), np.int64),
+            __treedef__=np.frombuffer(
+                repr(treedef).encode(), dtype=np.uint8),
+            **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+
+    @staticmethod
+    def load(path: str, rt=None, like: SimState | None = None
+             ) -> "LaneCheckpoint":
+        """Read a checkpoint written by `save`. Pass the runtime it will
+        be seeded into (`rt`, preferred — supplies both the pytree
+        structure and the structural signature for the world-shape
+        check) or a bare single-lane `like` state for structure only.
+
+        Rejections are CLEAN and typed: a file without the r20 header
+        (e.g. a pre-r20 `runtime.checkpoint.save` batch snapshot) or
+        with a mismatched format version raises CheckpointMismatch, as
+        does a stored signature whose WORLD slice disagrees with `rt`'s
+        (observability fields may differ — that is window replay's
+        upgrade path, resolved leaf-by-leaf in `seed_batch_from`)."""
+        import ast
+        if rt is not None and like is None:
+            like = rt._template
+        if like is None:
+            raise ValueError("LaneCheckpoint.load needs rt= or like= "
+                             "to supply the pytree structure")
+        with np.load(path) as z:
+            if "__lane_ckpt__" not in z.files:
+                raise CheckpointMismatch(
+                    f"{path}: no lane-checkpoint header — a pre-r20 "
+                    "snapshot (runtime.checkpoint.save batch format?) "
+                    "cannot be loaded as a LaneCheckpoint")
+            fmt = bytes(z["__lane_ckpt__"]).decode()
+            if fmt != _LANE_CKPT_FORMAT:
+                raise CheckpointMismatch(
+                    f"{path}: lane-checkpoint format {fmt!r} != "
+                    f"{_LANE_CKPT_FORMAT!r}")
+            sig = ast.literal_eval(bytes(z["__signature__"]).decode())
+            steps = int(z["__steps__"])
+            # the signature is the authoritative world contract — check
+            # it BEFORE leaf counting so a foreign world is named as
+            # such, not as a leaf-count coincidence
+            if (rt is not None and sig is not None
+                    and _world_slice(sig)
+                    != _world_slice(rt.cfg.structural_signature())):
+                raise CheckpointMismatch(
+                    f"{path}: checkpoint world signature "
+                    f"{_world_slice(sig)} != runtime's "
+                    f"{_world_slice(rt.cfg.structural_signature())}")
+            leaves_like, treedef = jax.tree.flatten(like)
+            n = len([k for k in z.files if k.startswith("leaf_")])
+            if n != len(leaves_like):
+                raise CheckpointMismatch(
+                    f"{path}: checkpoint has {n} leaves, target expects "
+                    f"{len(leaves_like)} — different world/model?")
+            state = jax.tree.unflatten(
+                treedef, [z[f"leaf_{i}"] for i in range(n)])
+        return LaneCheckpoint(state=state, steps=steps, signature=sig)
+
+
+def _tree_spec_equal(a, b) -> bool:
+    if jax.tree.structure(a) != jax.tree.structure(b):
+        return False
+    return all(np.shape(x) == np.shape(y)
+               and np.asarray(x).dtype == np.asarray(y).dtype
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def seed_batch_from(ckpt: LaneCheckpoint, batch: int, rt=None,
+                    reset_planes: tuple = ()) -> SimState:
+    """Broadcast a lane checkpoint into a fresh [batch]-lane SimState:
+    every lane a clone of the checkpointed lane, mid-trajectory. With
+    unchanged knobs/nudge each lane continues leaf-for-leaf
+    bit-identical to the parent (the fidelity contract,
+    tests/test_timetravel.py); perturb lanes afterwards
+    (`with_prio_nudge`, `KnobPlan.apply`) to FORK the trajectory — the
+    prefix-fork primitive.
+
+    rt=None broadcasts the checkpoint verbatim (the caller promises a
+    structurally identical runtime). With `rt`, the checkpoint is
+    validated against — and adapted to — that runtime: every
+    replay-domain leaf must match shape/dtype exactly
+    (CheckpointMismatch otherwise — a different world NEVER silently
+    produces garbage), while observation planes whose compiled shape
+    differs are re-initialized from the runtime's template (the
+    observability-UPGRADE path: replay a checkpoint captured ring-off
+    under a big ring/profiler/latency build; same trajectory, DESIGN
+    §21). `reset_planes` names planes to re-initialize even when their
+    shapes match (e.g. ("ring",) for a window replay that must start
+    from an empty ring)."""
+    unknown = set(reset_planes) - set(_CKPT_PLANES)
+    if unknown:
+        raise ValueError(f"unknown reset_planes {sorted(unknown)} — "
+                         f"valid planes: {sorted(_CKPT_PLANES)}")
+    if reset_planes and rt is None:
+        # fresh plane values come from the runtime's template — without
+        # it the reset would be a silent no-op (the clones would carry
+        # the parent's ring/counters into the "fresh" window)
+        raise ValueError("reset_planes needs rt= (the reset re-"
+                         "initializes planes from the runtime template)")
+    src = ckpt.state
+    if rt is None:
+        merged = src
+    else:
+        if ckpt.signature is not None:
+            want = _world_slice(rt.cfg.structural_signature())
+            got = _world_slice(ckpt.signature)
+            if got != want:
+                raise CheckpointMismatch(
+                    f"checkpoint world signature {got} != runtime's "
+                    f"{want} — different cluster/world shape")
+        tpl = rt._template
+        plane_of = {f: p for p, fs in _CKPT_PLANES.items() for f in fs}
+        fresh = {p: (p in reset_planes
+                     or not _tree_spec_equal(
+                         {f: getattr(src, f) for f in fs},
+                         {f: getattr(tpl, f) for f in fs}))
+                 for p, fs in _CKPT_PLANES.items()}
+        vals = {}
+        for f in type(src).__dataclass_fields__:
+            s_v, t_v = getattr(src, f), getattr(tpl, f)
+            plane = plane_of.get(f)
+            if plane is not None:
+                vals[f] = t_v if fresh[plane] else s_v
+                continue
+            # replay-domain leaf (hash_base included — consumed by
+            # ctx.hash_key): must fit the target world exactly
+            if not _tree_spec_equal(s_v, t_v):
+                raise CheckpointMismatch(
+                    f"checkpoint leaf {f!r} does not fit the target "
+                    f"runtime (shape/dtype/structure mismatch) — "
+                    f"different world or model schema")
+            vals[f] = s_v
+        merged = type(src)(**vals)
+    B = int(batch)
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(jnp.asarray(a),
+                                   (B,) + jnp.asarray(a).shape), merged)
 
 

@@ -42,12 +42,25 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   the coverage/rate/p99 curves, attribution bars,
                   bucket lifecycle table with repro one-liners — no
                   server, no JS deps; pure read side of the store.
+  * timetravel.py—(r20) the WHEN-AGAIN layer: lane checkpoints
+                  harvested at existing chunk syncs
+                  (`run(ckpt_every=K)` -> CheckpointLog), window
+                  replay with observability UPGRADED
+                  (`replay_window` / `explain_crash(replay=True)`
+                  recover FULL untruncated causal chains + focused
+                  Perfetto window traces; equivalence asserted on
+                  fingerprint + crash verdict), and the divergence
+                  microscope (`divergence_report` names two lanes'
+                  first divergent dispatch by replaying from their
+                  last common checkpoint under full tracing).
 """
 
 from .causal import (causal_fingerprint, code_fingerprint, explain_crash,
                      fingerprints_match, happens_before, sketch_divergence)
 from .dashboard import render_html, sparkline_svg
 from .metrics import JsonlObserver, SweepObserver, TeeObserver
+from .timetravel import (CheckpointLog, ReplayDivergence, divergence_report,
+                         full_chain_replay, replay_window)
 from .profiler import (counter_track_events, curve_brief,
                        export_profile_trace,
                        format_latency, format_profile,
@@ -67,4 +80,6 @@ __all__ = [
     "export_profile_trace",
     "latency_summary", "format_latency", "latency_histogram_rows",
     "render_html", "sparkline_svg", "curve_brief",
+    "CheckpointLog", "replay_window", "full_chain_replay",
+    "divergence_report", "ReplayDivergence",
 ]

@@ -58,7 +58,10 @@ def happens_before(recs: dict) -> list[tuple[int, int]]:
             if int(p) >= 0 and int(p) in present]
 
 
-def explain_crash(state, lane: int = 0) -> dict:
+def explain_crash(state, lane: int = 0, *, replay: bool = False,
+                  rt=None, ckpts=None, max_steps: int = 100_000,
+                  chunk: int = 512, trace_cap: int | None = None,
+                  export_trace: str | None = None) -> dict:
     """Walk parent edges backward from a lane's last recorded dispatch —
     for a crashed lane, the crash dispatch (the invariant/deadlock check
     runs inside the same step it implicates) — to the minimal causal
@@ -75,10 +78,32 @@ def explain_crash(state, lane: int = 0) -> dict:
       crashed / crash_code / crash_node   the lane's crash verdict
       lane, dropped   lane index and ring-wrap overwrite count
 
+    replay=True (r20, DESIGN §21) refuses to settle for the truncated
+    suffix: pass the runtime (`rt=`) and the sweep's harvested
+    `ckpts=` (an obs.timetravel.CheckpointLog from
+    `run(ckpt_every=...)`) and the chain is recovered by WINDOW REPLAY
+    from the nearest checkpoint with the ring upgraded to hold the
+    whole window — `truncated=False` guaranteed whenever a checkpoint
+    precedes the chain's root, equivalence asserted on fingerprint +
+    crash verdict, and `export_trace=` writes a focused Perfetto trace
+    of just the window. The replayed-complete chain stays
+    bucket-compatible with the live truncated observation
+    (deepest-common-suffix, `fingerprints_match`).
+
     Raises (via ring_records) if the ring is compiled out or the lane
     was not sampled; raises ValueError on an empty ring or a pre-r10
     state without lineage columns.
     """
+    if replay:
+        if rt is None:
+            raise ValueError("explain_crash(replay=True) needs rt= (and "
+                             "usually ckpts= — a CheckpointLog harvested "
+                             "with run(ckpt_every=...))")
+        from .timetravel import time_travel_explain
+        return time_travel_explain(rt, state, lane, ckpts=ckpts,
+                                   max_steps=max_steps, chunk=chunk,
+                                   trace_cap=trace_cap,
+                                   export_trace=export_trace)
     recs = ring_records(state, lane)
     if "parent" not in recs:
         raise ValueError("no lineage columns: state predates r10 or was "
@@ -246,11 +271,25 @@ def fingerprints_match(a: dict, b: dict) -> bool:
 def sketch_divergence(state, lane_a: int, lane_b: int) -> dict:
     """Where two lanes' schedules first diverged, from their on-device
     prefix-coverage sketches (cfg.sketch_slots > 0). Returns
-    {slot, step_bound, every, slots}: `slot` is the first sketch index
-    where the lanes differ (== slots when no recorded checkpoint
-    differs), and `step_bound` the corresponding upper bound on the
-    first divergent dispatch index — the lanes' first `slot * every`
-    dispatches hashed identically."""
+    {slot, step_bound, every, slots, bound}: `slot` is the first sketch
+    index where the lanes differ, `step_bound` the corresponding upper
+    bound on the first divergent dispatch index — the lanes' first
+    `slot * every` dispatches hashed identically.
+
+    `bound` names WHICH kind of answer this is, instead of callers
+    inferring it from `slot == slots` (the r20 small fix):
+      "sketch-slot"  a recorded slot genuinely differs — `step_bound`
+                     is a real divergence bound;
+      "exhausted"    NO recorded checkpoint differs (identical
+                     schedules within the sketch window, or divergence
+                     past slot `slots`, or the lanes halted before
+                     filling the differing slot) — `slot == slots` and
+                     `step_bound` is only the end of the recorded
+                     window, NOT evidence of divergence.
+    Consumers that need a true step: the divergence microscope
+    (obs/timetravel.divergence_report) refines "sketch-slot" to an
+    exact checkpoint-step by window replay and falls back to the whole
+    run on "exhausted"."""
     sk = np.asarray(state.cov_sketch)
     if sk.ndim != 2 or sk.shape[1] == 0:
         raise ValueError("prefix sketch is compiled out "
@@ -259,6 +298,8 @@ def sketch_divergence(state, lane_a: int, lane_b: int) -> dict:
     a, b = sk[lane_a], sk[lane_b]
     differs = a != b
     slots = sk.shape[1]
-    slot = int(differs.argmax()) if differs.any() else slots
+    found = bool(differs.any())
+    slot = int(differs.argmax()) if found else slots
     return dict(slot=slot, step_bound=(slot + 1) * every, every=every,
-                slots=slots)
+                slots=slots,
+                bound="sketch-slot" if found else "exhausted")

@@ -266,6 +266,19 @@ def bucket_table_html(cur: dict, diff: dict | None) -> str:
         cls = _lifecycle_of(key, diff)
         a = bucket_audit(cur, key, b.get("members", ()))
         astat = (a or {}).get("status", "unaudited")
+        # r20 chain column: complete chain vs truncated-at-wrap, with
+        # the replayed-window trace linked when replay_bucket/audit
+        # wrote one (a file path — the dashboard is serverless, so the
+        # link is the store-relative name, always worded)
+        if "chain_complete" not in b:
+            chain = '<span class="sub">unknown</span>'
+        else:
+            chain = ("complete" if b["chain_complete"]
+                     else "truncated at wrap")
+            if b.get("window_trace"):
+                chain += (' &middot; <span class="mono">buckets/'
+                          f"{_esc(b['window_trace'][:16])}&hellip;"
+                          ".window.trace.json</span>")
         rows.append(
             "<tr>"
             f'<td class="mono">{_esc(key[:16])}</td>'
@@ -276,14 +289,15 @@ def bucket_table_html(cur: dict, diff: dict | None) -> str:
             f"<td>{b['observations']}</td>"
             f"<td>{b['first_round']}&ndash;{b['last_round']}</td>"
             f"<td>{_badge(astat)}</td>"
+            f"<td>{chain}</td>"
             f'<td class="mono">{_esc(_repro_line(b))}</td>'
             "</tr>")
     if not rows:
-        rows = ['<tr><td colspan="9" class="sub">no buckets — the '
+        rows = ['<tr><td colspan="10" class="sub">no buckets — the '
                 "campaign found no crashes (yet)</td></tr>"]
     head = "".join(f"<th>{h}</th>" for h in (
         "bucket", "lifecycle", "code", "recipe", "operator", "obs",
-        "rounds", "repro health", "repro handle"))
+        "rounds", "repro health", "chain", "repro handle"))
     return (f'<table class="buckets"><thead><tr>{head}</tr></thead>'
             f'<tbody>{"".join(rows)}</tbody></table>')
 

@@ -1,0 +1,537 @@
+"""Time-travel replay (r20, DESIGN §21): lane checkpoints, full-fidelity
+window replay with UPGRADED observability, and the divergence microscope.
+
+The engine's promise is that one seed reproduces an entire execution;
+until r20 the debugging story still topped out at *printing* whatever
+the live run happened to record — `explain_crash` chains truncate at
+ring wrap, and a sweep that ran lean (ring off, profiler off) was blind
+after the fact. The checkpoint primitive (core/state.checkpoint_lane /
+seed_batch_from) closes the gap: any harvested lane snapshot re-seeds a
+fresh batch that continues bit-identically, and because every
+observability plane is observation-ONLY (TRACE_FIELDS — no randomness,
+no replay-domain writes), the continuation may be compiled with MORE
+instrumentation than the original run without changing the trajectory.
+"Replay the window with a big ring" is therefore a sound operation, and
+this module packages the three moves built on it:
+
+  * `CheckpointLog` — the harvest `Runtime.run(ckpt_every=K)` /
+    `run_fused(ckpt_every=K)` fill at their existing chunk syncs;
+  * `replay_window` / `full_chain_replay` / `time_travel_explain` —
+    re-execute from the nearest checkpoint with ring/profiler/latency
+    plane upgraded, assert equivalence on fingerprint + crash verdict,
+    and recover the FULL (`truncated=False`) causal chain plus a
+    focused Perfetto trace of just the window;
+  * `divergence_report` — the microscope: bound two lanes' first
+    schedule divergence with the r10 cov_sketch, replay both lanes from
+    the last common checkpoint under full tracing, and name the first
+    divergent dispatch (step, node, kind, the tie that flipped) with
+    side-by-side ring suffixes and a two-track Perfetto export.
+
+Equivalence discipline: a replay's claim is only as good as its match
+to the live observation, so every replay that has a live reference
+asserts fingerprint + crash verdict against it (ReplayDivergence on
+mismatch, with one retry to absorb the known jaxlib persistent-cache
+first-invocation transient — ROADMAP r12)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core.state import LaneCheckpoint, checkpoint_lane, seed_batch_from
+from ..utils.hostcopy import owned_host_copy
+from . import causal
+from .rings import ring_records
+from .trace import _doc, export_chrome_trace, to_chrome_events
+
+
+class ReplayDivergence(RuntimeError):
+    """A window replay did not reproduce the live observation
+    (fingerprint or crash verdict mismatch after the one-retry
+    transient guard) — either the checkpoint belongs to a different
+    run or the engine is genuinely nondeterministic here."""
+
+
+class CheckpointLog:
+    """The harvest of a `run(ckpt_every=K)` / `run_fused(ckpt_every=K)`
+    sweep: owned host copies of the whole batch at successive
+    ~K-step boundaries, read back per lane as `LaneCheckpoint`s.
+
+    Memory: one snapshot is a full host copy of the batch state (the
+    price of being able to re-seed ANY lane); `keep` bounds the window
+    (oldest dropped) — None keeps everything, the default for
+    debugging-sized sweeps. `signature` is stamped by the harvesting
+    runtime so checkpoints carry the world-shape contract."""
+
+    def __init__(self, every: int | None = None, keep: int | None = None):
+        self.every = every
+        self.keep = keep
+        self.signature = None
+        self.snaps: list[dict] = []   # dicts: steps_done, state (host)
+
+    def __len__(self) -> int:
+        return len(self.snaps)
+
+    def harvest(self, state, steps_done: int | None = None) -> None:
+        """Append one snapshot (owned host copy — safe across later
+        donated runs of the same buffers, utils/hostcopy). The
+        CURRENT `signature` (stamped by the harvesting runtime's
+        _ckpt_setup) is captured PER SNAPSHOT: a log accumulated
+        across runs of different runtimes keeps each snapshot's own
+        world contract — a later run must not retroactively re-badge
+        earlier harvests."""
+        self.snaps.append(dict(steps_done=steps_done,
+                               state=owned_host_copy(state),
+                               signature=self.signature))
+        if self.keep is not None and len(self.snaps) > self.keep:
+            del self.snaps[0]
+
+    def lane_steps(self, lane: int) -> list[int]:
+        """The lane's dispatch count at each snapshot (monotone; stops
+        advancing once the lane halts)."""
+        return [int(np.asarray(s["state"].steps)[lane])
+                for s in self.snaps]
+
+    def iter_checkpoints(self, lane: int, before_step: int | None = None,
+                         live_only: bool = True):
+        """Lazily yield the lane's checkpoints NEWEST first — the
+        per-leaf gather + owned host copy is paid per checkpoint
+        CONSUMED, so callers that stop at the first (nearest/
+        time_travel_explain's common case) never materialize the rest.
+        `before_step` keeps only snapshots taken at or before that
+        dispatch count; `live_only` (default) drops snapshots where the
+        lane had already halted — a halted lane's snapshot is its final
+        state, not a restart point."""
+        for snap in reversed(self.snaps):
+            st = snap["state"]
+            if live_only and bool(np.asarray(st.halted)[lane]):
+                continue
+            steps = int(np.asarray(st.steps)[lane])
+            if before_step is not None and steps > before_step:
+                continue
+            yield checkpoint_lane(st, lane,
+                                  signature=snap.get("signature",
+                                                     self.signature))
+
+    def checkpoints(self, lane: int, before_step: int | None = None,
+                    live_only: bool = True) -> list[LaneCheckpoint]:
+        """`iter_checkpoints` materialized to a list."""
+        return list(self.iter_checkpoints(lane, before_step=before_step,
+                                          live_only=live_only))
+
+    def nearest(self, lane: int, step: int | None = None,
+                live_only: bool = True) -> LaneCheckpoint | None:
+        """The LATEST checkpoint of `lane` at or before `step` (None =
+        the latest live one) — the one window replay restarts from."""
+        return next(self.iter_checkpoints(lane, before_step=step,
+                                          live_only=live_only), None)
+
+
+# ---------------------------------------------------------------------------
+# exact-step advance + handle-based checkpoints
+# ---------------------------------------------------------------------------
+
+def advance_exact(rt, state, steps: int, chunk: int = 512):
+    """Advance a batched state by EXACTLY `steps` dispatches per live
+    lane (power-of-two chunk decomposition, the `state_at` discipline:
+    at most log2(chunk) distinct scan lengths ever compile, shared
+    through the program cache). Halted lanes freeze; an all-halted
+    batch stops early."""
+    remaining = int(steps)
+    runner = rt._run_chunk[False]
+    while remaining > 0:
+        c = min(int(chunk), 1 << (remaining.bit_length() - 1))
+        state, _ = runner(state, c)
+        remaining -= c
+        if bool(state.halted.all()):
+            break
+    return state
+
+
+def init_checkpoint(rt, seed: int, knobs: dict | None = None,
+                    nudge: int | None = None) -> LaneCheckpoint:
+    """The trivial checkpoint every repro handle implies: the t=0 state
+    of `(seed[, knobs][, nudge])` on `rt`. Makes "no harvested
+    checkpoint" a degenerate case of window replay instead of a
+    different code path — replaying from init IS replaying from the
+    step-0 checkpoint (just the most expensive one)."""
+    state = rt.init_batch(np.asarray([seed], np.uint32))
+    if knobs is not None:
+        from ..search.mutate import apply_repro_knobs
+        state, _ = apply_repro_knobs(rt, state, knobs)
+    if nudge is not None:
+        from ..search.pct import with_prio_nudge
+        state = with_prio_nudge(state, np.asarray([nudge], np.int32))
+    return checkpoint_lane(state, 0,
+                           signature=rt.cfg.structural_signature())
+
+
+# ---------------------------------------------------------------------------
+# window replay
+# ---------------------------------------------------------------------------
+
+def _verdict_of(state, lane: int = 0) -> dict:
+    def pick(leaf):
+        a = np.asarray(leaf)
+        return a.reshape(-1)[lane] if a.ndim else a
+    return dict(crashed=bool(pick(state.crashed)),
+                crash_code=int(pick(state.crash_code)),
+                crash_node=int(pick(state.crash_node)))
+
+
+def replay_window(rt, ckpt: LaneCheckpoint, *, until_step: int | None = None,
+                  max_steps: int = 100_000, chunk: int = 512,
+                  trace_cap: int | None = None, profile: bool | None = None,
+                  latency_hist: int | None = None,
+                  sketch_slots: int | None = None,
+                  expect: dict | None = None,
+                  export_trace: str | None = None, batch: int = 1) -> dict:
+    """Re-execute from a lane checkpoint with observability UPGRADED.
+
+    Derives a runtime from `rt` with the requested planes compiled in
+    (`trace_cap` defaults to covering the whole window so the ring
+    never wraps; `profile`/`latency_hist`/`sketch_slots` override when
+    not None), seeds a `batch`-clone child from `ckpt`
+    (`seed_batch_from` adapts the observation planes, resetting the
+    ring so the window starts from an empty recorder), and runs it —
+    to exactly `until_step` total dispatches (exact-step advance) or
+    until crash/halt (`until_step=None`, bounded by `max_steps`).
+
+    `expect` asserts equivalence against the live observation: any of
+    crashed/crash_code/crash_node/fingerprint present in the dict is
+    compared to the replay (only meaningful for a full replay to halt);
+    a mismatch is retried ONCE (the known persistent-cache
+    first-invocation transient never survives re-invocation) and then
+    raises ReplayDivergence.
+
+    Returns {state, rt (the upgraded runtime), from_step, steps,
+    fingerprint, crashed, crash_code, crash_node[, trace_path]};
+    `export_trace` additionally writes the lane-0 ring as a focused
+    Perfetto trace of JUST the window."""
+    overrides: dict = {}
+    if trace_cap is None:
+        span = (int(until_step) - ckpt.steps if until_step is not None
+                else int(max_steps))
+        trace_cap = max(16, span)
+    overrides["trace_cap"] = int(trace_cap)
+    if profile is not None:
+        overrides["profile"] = bool(profile)
+    if latency_hist is not None:
+        overrides["latency_hist"] = int(latency_hist)
+    if sketch_slots is not None:
+        overrides["sketch_slots"] = int(sketch_slots)
+    changed = {k: v for k, v in overrides.items()
+               if getattr(rt.cfg, k) != v}
+    wrt = rt.derived(**changed) if changed else rt
+
+    def once():
+        st = seed_batch_from(ckpt, batch, rt=wrt, reset_planes=("ring",))
+        if until_step is not None:
+            st = advance_exact(wrt, st, int(until_step) - ckpt.steps, chunk)
+        else:
+            st = wrt.run_fused(st, max_steps, chunk)
+        return st
+
+    st = once()
+    out = dict(state=st, rt=wrt, from_step=int(ckpt.steps),
+               steps=int(np.asarray(st.steps).reshape(-1)[0]),
+               fingerprint=int(wrt.fingerprints(st)[0]),
+               **_verdict_of(st, 0))
+    if expect is not None:
+        def mismatches(o):
+            return [k for k in ("crashed", "crash_code", "crash_node",
+                                "fingerprint")
+                    if k in expect and expect[k] != o[k]]
+        bad = mismatches(out)
+        if bad:
+            # one retry: the jaxlib persistent-cache first-invocation
+            # corruption (ROADMAP r12) is transient and never survives
+            # a re-invocation; a second mismatch is a real divergence
+            st = once()
+            out.update(state=st,
+                       steps=int(np.asarray(st.steps).reshape(-1)[0]),
+                       fingerprint=int(wrt.fingerprints(st)[0]),
+                       **_verdict_of(st, 0))
+            bad = mismatches(out)
+            if bad:
+                raise ReplayDivergence(
+                    f"window replay from step {ckpt.steps} does not "
+                    f"reproduce the live observation on {bad}: "
+                    f"expected { {k: expect[k] for k in bad} }, "
+                    f"replayed { {k: out[k] for k in bad} }")
+    if export_trace is not None:
+        export_chrome_trace(export_trace, state=st, lane=0)
+        out["trace_path"] = export_trace
+    return out
+
+
+def full_chain_replay(rt, *, ckpt: LaneCheckpoint | None = None,
+                      seed: int | None = None, knobs: dict | None = None,
+                      nudge: int | None = None, expect: dict | None = None,
+                      max_steps: int = 100_000, chunk: int = 512,
+                      trace_cap: int | None = None,
+                      until_step: int | None = None,
+                      export_trace: str | None = None) -> dict:
+    """Replay to halt — or to exactly `until_step` dispatches, for a
+    lane whose live observation was still running — from `ckpt` (or
+    from t=0 via the (seed[, knobs][, nudge]) handle) with a ring
+    sized to hold the whole window, then explain the final dispatch
+    off the unwrapped ring. Returns the `replay_window` dict plus
+    `explain` — the chain is complete (`truncated=False`) whenever
+    the checkpoint precedes the crash's causal root (always, for the
+    t=0 checkpoint, ring capacity allowing)."""
+    if ckpt is None:
+        if seed is None:
+            raise ValueError("full_chain_replay needs ckpt= or a "
+                             "(seed[, knobs][, nudge]) handle")
+        ckpt = init_checkpoint(rt, seed, knobs=knobs, nudge=nudge)
+    win = replay_window(rt, ckpt, max_steps=max_steps, chunk=chunk,
+                        trace_cap=trace_cap, expect=expect,
+                        until_step=until_step,
+                        export_trace=export_trace)
+    exp = causal.explain_crash(win["state"], 0)
+    exp["replayed_from_step"] = int(ckpt.steps)
+    return dict(win, explain=exp)
+
+
+def time_travel_explain(rt, state, lane: int = 0, *, ckpts: CheckpointLog,
+                        max_steps: int = 100_000, chunk: int = 512,
+                        trace_cap: int | None = None,
+                        export_trace: str | None = None) -> dict:
+    """`explain_crash` that REPLAYS instead of settling for the live
+    ring's suffix: walk back through the lane's harvested checkpoints
+    (newest first), window-replay from each with a ring sized to hold
+    the whole window, and return the first chain that reaches its root
+    (`truncated=False` is GUARANTEED when some checkpoint precedes the
+    root — every post-checkpoint parent then resolves in the unwrapped
+    replay ring). Each replay is equivalence-checked against the live
+    lane (fingerprint + crash verdict, ReplayDivergence on mismatch).
+
+    Returns the `explain_crash` dict extended with `replayed=True`,
+    `from_step` (the checkpoint used), `fingerprint`, and
+    `trace_path` when `export_trace` wrote the focused window trace.
+    A live chain that is ALREADY complete returns as-is
+    (`replayed=False`) — no replay spent. Raises ValueError when no
+    harvested checkpoint covers the lane (harvest with
+    `run(ckpt_every=...)`, or use the (seed, knobs) handle via
+    `full_chain_replay` — t=0 is always a checkpoint there)."""
+    live = dict(_verdict_of(state, lane),
+                fingerprint=int(rt.fingerprints(state)[lane]))
+    try:
+        live_exp = causal.explain_crash(state, lane)
+    except ValueError:
+        live_exp = None          # ring compiled out / lane unsampled
+    if live_exp is not None and not live_exp["truncated"]:
+        out = dict(live_exp, replayed=False)
+        if export_trace is not None:
+            # the caller asked for the window trace either way — the
+            # live ring already holds the complete window, export THAT
+            export_chrome_trace(export_trace, state=state, lane=lane)
+            out["trace_path"] = export_trace
+        return out
+    crash_step = int(np.asarray(state.steps).reshape(-1)[lane])
+    # a crashed/halted lane is frozen: replay runs to halt and lands on
+    # the same final state. A lane the live sweep left RUNNING (hit its
+    # max_steps while live) must replay to exactly its live dispatch
+    # count — running further would honestly diverge the fingerprint.
+    live_halted = bool(np.asarray(state.halted).reshape(-1)[lane])
+    until = None if live_halted else crash_step
+    cks = (ckpts.iter_checkpoints(lane, before_step=crash_step)
+           if ckpts is not None else iter(()))
+    best = None
+    any_ckpt = False
+    for ckpt in cks:
+        any_ckpt = True
+        span = crash_step - ckpt.steps
+        rep = full_chain_replay(
+            rt, ckpt=ckpt, expect=live, max_steps=max_steps, chunk=chunk,
+            trace_cap=(trace_cap if trace_cap is not None
+                       else max(16, span)),
+            until_step=until,
+            export_trace=export_trace)
+        exp = dict(rep["explain"], replayed=True,
+                   from_step=int(ckpt.steps),
+                   fingerprint=rep["fingerprint"])
+        if "trace_path" in rep:
+            exp["trace_path"] = rep["trace_path"]
+        if not exp["truncated"]:
+            return exp
+        if best is None or len(exp["chain"]) > len(best["chain"]):
+            best = exp           # root precedes this checkpoint: step back
+    if not any_ckpt:
+        raise ValueError(
+            f"no harvested checkpoint covers lane {lane} before its "
+            f"crash at step {crash_step} — run with ckpt_every=..., or "
+            "replay the (seed, knobs) handle via full_chain_replay "
+            "(t=0 is always a checkpoint when the handle is known)")
+    return best                  # honest: still truncated at the oldest
+
+
+# ---------------------------------------------------------------------------
+# divergence microscope
+# ---------------------------------------------------------------------------
+
+_TOKEN_KEYS = ("kind", "node", "src", "tag")
+
+
+def _pair_state(prt, seed_a, seed_b, knobs_b, nudge_b):
+    seeds = np.asarray(
+        [seed_a, seed_b if seed_b is not None else seed_a], np.uint32)
+    st = prt.init_batch(seeds)
+    if knobs_b is not None:
+        from ..search.mutate import KnobPlan
+        plan = KnobPlan.from_runtime(
+            prt, dup_slots=len(np.atleast_1d(knobs_b["dup_src"])))
+        st = plan.apply(st, KnobPlan.stack([plan.base_knobs(), knobs_b]))
+    if nudge_b is not None:
+        from ..search.pct import with_prio_nudge
+        base = int(np.asarray(st.prio_nudge).reshape(-1)[0])
+        st = with_prio_nudge(st, np.asarray([base, int(nudge_b)], np.int32))
+    return st
+
+
+def _ring_token_rows(recs: dict) -> list[tuple]:
+    cols = [np.asarray(recs[k]) for k in _TOKEN_KEYS]
+    return [tuple(int(c[i]) for c in cols) for i in range(len(cols[0]))]
+
+
+def _rec_row(recs: dict, i: int) -> dict:
+    keys = ("step", "now", "kind", "node", "src", "tag", "parent",
+            "lamport")
+    return {k: int(np.asarray(recs[k])[i]) for k in keys if k in recs}
+
+
+def export_pair_trace(path: str, state_a, state_b,
+                      names=("lane_a", "lane_b")) -> int:
+    """One Perfetto document with BOTH lanes' tracks: lane A as pid 0,
+    lane B as pid 1, each with its per-node thread tracks, flow arrows
+    and instant args intact — open it and read the two schedules side
+    by side. Returns the total instant-event count."""
+    docs = []
+    for pid, (st, name) in enumerate(zip((state_a, state_b), names)):
+        evs = to_chrome_events(ring_records(st, 0))
+        body = _doc(evs, None, None)["traceEvents"]
+        for e in body:
+            e["pid"] = pid
+            # flow binding is by (cat, id) GLOBALLY, not per pid — both
+            # lanes replay the same window and emit the same step-keyed
+            # flow ids, so un-namespaced ids would draw bogus arrows
+            # BETWEEN the two tracks
+            if "id" in e:
+                e["id"] = (pid << 32) | int(e["id"])
+        docs.append(dict(name="process_name", ph="M", pid=pid,
+                         args=dict(name=name)))
+        docs.extend(body)
+    with open(path, "w") as f:
+        json.dump(dict(traceEvents=docs, displayTimeUnit="ms"), f)
+    return sum(1 for e in docs if e.get("ph") == "i")
+
+
+def divergence_report(rt, seed_a: int, seed_b: int | None = None, *,
+                      knobs_b: dict | None = None,
+                      nudge_b: int | None = None,
+                      max_steps: int = 20_000, chunk: int = 512,
+                      sketch_slots: int = 64, window_pad: int = 8,
+                      suffix: int = 16,
+                      export_trace: str | None = None) -> dict:
+    """The divergence microscope: turn "these lanes diverged somewhere
+    around slot 12" into a NAMED first divergent dispatch.
+
+    Lane A runs `seed_a` untouched; lane B is `seed_b`, or `seed_a`
+    under `knobs_b` (a fuzz mutant's knob vector) and/or `nudge_b` (a
+    PCT tie-break policy — the confirm_race shape). Three moves:
+
+      1. PROBE: run the pair on a sketch-compiled build (derived when
+         `rt` lacks one); `sketch_divergence` bounds the first
+         divergent schedule slot — `bound="sketch-slot"` gives the
+         window [slot*every, (slot+1)*every]; `bound="exhausted"`
+         (fingerprints differ but no recorded slot does) falls back to
+         the whole run.
+      2. REPLAY the window: advance a fresh pair exactly to the window
+         start (the last COMMON checkpoint), `checkpoint_lane` both
+         lanes, re-seed each through a big-ring derived build
+         (`seed_batch_from` upgrade path, ring reset), run the window
+         under full tracing.
+      3. DIFF step-aligned: the first ring index where the two lanes'
+         dispatch tokens (kind, node, src, tag) differ is the first
+         divergent dispatch — reported with both sides' records (the
+         scheduler tie that flipped), `suffix` records of side-by-side
+         ring context, and (optionally) a two-track Perfetto export.
+
+    Deterministic: the same pair yields the same report, dispatch for
+    dispatch (the --tt-smoke gate re-runs it and compares)."""
+    if seed_b is None and knobs_b is None and nudge_b is None:
+        raise ValueError("nothing to diverge: pass seed_b, knobs_b "
+                         "and/or nudge_b")
+    prt = rt if rt.cfg.sketch_slots > 0 else rt.derived(
+        sketch_slots=int(sketch_slots))
+    st = prt.run_fused(_pair_state(prt, seed_a, seed_b, knobs_b, nudge_b),
+                       max_steps, chunk)
+    fps = prt.fingerprints(st)
+    verdicts = (_verdict_of(st, 0), _verdict_of(st, 1))
+    probe = causal.sketch_divergence(st, 0, 1)
+    every = probe["every"]
+    steps_ab = np.asarray(st.steps).reshape(-1)
+    diverged = (int(fps[0]) != int(fps[1])
+                or probe["bound"] == "sketch-slot"
+                or verdicts[0] != verdicts[1])
+    out = dict(diverged=bool(diverged), probe=probe,
+               fingerprints=(int(fps[0]), int(fps[1])),
+               verdicts=verdicts,
+               steps=(int(steps_ab[0]), int(steps_ab[1])))
+    if not diverged:
+        return out
+    if probe["bound"] == "sketch-slot":
+        window_start = probe["slot"] * every
+        window_len = every + int(window_pad)
+    else:
+        window_start = 0
+        window_len = int(min(max_steps, max(steps_ab))) + int(window_pad)
+    # 2. window replay from the last common checkpoint, full tracing
+    st2 = _pair_state(prt, seed_a, seed_b, knobs_b, nudge_b)
+    if window_start:
+        st2 = advance_exact(prt, st2, window_start, chunk)
+    sig = prt.cfg.structural_signature()
+    ck_a = checkpoint_lane(st2, 0, signature=sig)
+    ck_b = checkpoint_lane(st2, 1, signature=sig)
+    trt = prt.derived(trace_cap=max(16, window_len))
+    sa = advance_exact(
+        trt, seed_batch_from(ck_a, 1, rt=trt, reset_planes=("ring",)),
+        window_len, chunk)
+    sb = advance_exact(
+        trt, seed_batch_from(ck_b, 1, rt=trt, reset_planes=("ring",)),
+        window_len, chunk)
+    ra, rb = ring_records(sa, 0), ring_records(sb, 0)
+    ta, tb = _ring_token_rows(ra), _ring_token_rows(rb)
+    n = min(len(ta), len(tb))
+    first = None
+    for i in range(n):
+        if ta[i] != tb[i]:
+            first = dict(index=i, step=int(np.asarray(ra["step"])[i]),
+                         a=_rec_row(ra, i), b=_rec_row(rb, i),
+                         kind="dispatch")
+            break
+    if first is None and len(ta) != len(tb):
+        # schedules agree through the shorter window: the divergence IS
+        # one lane halting (crash/halt) while the other dispatches on
+        i = n
+        longer, recs = ("a", ra) if len(ta) > len(tb) else ("b", rb)
+        first = dict(index=i,
+                     step=int(np.asarray(recs["step"])[i]),
+                     a=_rec_row(ra, i) if longer == "a" else None,
+                     b=_rec_row(rb, i) if longer == "b" else None,
+                     kind="halt")
+    lo = first["index"] if first is not None else 0
+    out.update(
+        window_start=int(ck_a.steps), window_len=int(window_len),
+        bound=probe["bound"], slot=probe["slot"],
+        first=first,
+        suffix_a=[_rec_row(ra, i)
+                  for i in range(lo, min(lo + int(suffix), len(ta)))],
+        suffix_b=[_rec_row(rb, i)
+                  for i in range(lo, min(lo + int(suffix), len(tb)))])
+    if export_trace is not None:
+        export_pair_trace(export_trace, sa, sb)
+        out["trace_path"] = export_trace
+    return out

@@ -13,6 +13,8 @@ across the host boundary, never the full [B] hash array.
 
 from __future__ import annotations
 
+from collections import OrderedDict
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -253,16 +255,69 @@ def profile_digest(state):
                            state.now)
 
 
+# confirmed-digest memo: (digest name, input-leaf ids) -> (leaf refs,
+# result). The held leaf references keep the ids from being reused, so
+# an id-tuple hit is a true identity hit; bounded LRU, entries are
+# O(counters) plus the input device arrays they pin.
+_DIGEST_MEMO: OrderedDict = OrderedDict()
+_DIGEST_MEMO_CAP = 8
+
+
+def _confirmed_digest(digest, state, leaves) -> dict | None:
+    """Host-materialize a masked digest CONFIRMED by two agreeing
+    invocations (`utils/verify.agree_twice`, the r12/r13 playbook
+    applied to the report boundary), MEMOIZED on the identity of its
+    input leaves: the known jaxlib compile-cache transient (ROADMAP
+    r12 item, sharpened r16/r20) can corrupt digest invocations in a
+    long-lived process — observed both as a one-off (next invocation
+    correct) and STICKY (an early invocation correct, later ones
+    folding the masked gate to all-zero). agree-twice absorbs the
+    one-off; the memo absorbs the sticky shape (the digest is a pure
+    function of immutable arrays, so the first confirmed result for a
+    given state is THE result — re-deriving it can only re-roll the
+    transient). Also saves a launch on the common
+    counters-then-summary call pattern."""
+    from ..utils.verify import agree_twice
+    key = (getattr(digest, "__name__", str(digest)),
+           tuple(map(id, leaves)))
+    hit = _DIGEST_MEMO.get(key)
+    if hit is not None:
+        _DIGEST_MEMO.move_to_end(key)
+        return hit[1]
+    d = digest(state)
+    if d is None:
+        return None
+
+    def host(dd):
+        return {k: np.asarray(v) for k, v in dd.items()}
+
+    out = agree_twice(
+        host(d), lambda _: host(digest(state)),
+        key_of=lambda r: tuple((k, r[k].tobytes()) for k in sorted(r)),
+        what="masked-digest reduction")
+    _DIGEST_MEMO[key] = (tuple(leaves), out)
+    while len(_DIGEST_MEMO) > _DIGEST_MEMO_CAP:
+        _DIGEST_MEMO.popitem(last=False)
+    return out
+
+
 def profile_counters(state) -> dict | None:
     """Materialize `profile_digest` host-side: plain numpy/int values
     (the split 16-bit half-sums recombined into exact int64s), None
     when the plane is compiled out. The raw-counter half of the
     profiler report — `obs.profiler.profile_summary` derives the
-    human-facing rates (busy%, drop rate, mean delay) from it."""
-    d = profile_digest(state)
+    human-facing rates (busy%, drop rate, mean delay) from it.
+    Run-twice confirmed + memoized (`_confirmed_digest`)."""
+    pf = getattr(state, "pf_busy", None)
+    if pf is None or pf.ndim != 2 or pf.shape[1] == 0:
+        return None
+    d = _confirmed_digest(
+        profile_digest, state,
+        (state.pf_dispatch, state.pf_busy, state.pf_kill,
+         state.pf_restart, state.pf_qmax, state.pf_drop,
+         state.pf_delay, state.pf_on, state.steps, state.now))
     if d is None:
         return None
-    d = {k: np.asarray(v) for k, v in d.items()}
 
     def wide(a):        # hi·2^16 + lo — exact, however big the batch sum
         a = a.astype(np.int64)
@@ -377,11 +432,16 @@ def latency_digest(state):
 def latency_counters(state) -> dict | None:
     """Materialize `latency_digest` host-side: exact merged histograms
     (int64[N, B]), total SLO misses, and the quantile estimates in
-    ticks (µs). None when the plane is compiled out."""
-    d = latency_digest(state)
+    ticks (µs). None when the plane is compiled out. Run-twice
+    confirmed + memoized (`_confirmed_digest`)."""
+    lh = getattr(state, "lh_e2e", None)
+    if lh is None or lh.ndim != 3 or lh.shape[1] == 0 or lh.shape[2] == 0:
+        return None
+    d = _confirmed_digest(
+        latency_digest, state,
+        (state.lh_sojourn, state.lh_e2e, state.lh_slo_miss, state.lh_on))
     if d is None:
         return None
-    d = {k: np.asarray(v) for k, v in d.items()}
 
     def wide(a):
         a = a.astype(np.int64)

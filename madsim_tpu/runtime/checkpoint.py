@@ -6,6 +6,18 @@ pytree of device arrays, so a checkpoint is a device-to-host copy: save a
 100k-seed fuzz mid-flight, resume it later (or elsewhere), or stash the
 exact pre-crash batch for postmortem. This is strictly beyond reference
 parity, enabled by the state-as-tensor design.
+
+Two checkpoint shapes exist (MIGRATION r20):
+
+  * this module's BATCH snapshot — the whole [B]-lane pytree, headerless
+    npz, loaded back against a `like` state from the SAME runtime;
+  * the LANE checkpoint (core/state.checkpoint_lane / LaneCheckpoint,
+    re-exported here) — one lane's state with a VERSIONED header
+    (format marker + structural signature), the unit time-travel
+    replay and prefix-fork build on: `seed_batch_from` re-seeds it
+    into a fresh batch, including one with MORE observability compiled
+    in (DESIGN §21). `LaneCheckpoint.load` rejects this module's
+    headerless batch files cleanly — the formats never alias.
 """
 
 from __future__ import annotations
@@ -13,7 +25,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..core.state import SimState
+from ..core.state import (CheckpointMismatch, LaneCheckpoint,  # noqa: F401
+                          SimState, checkpoint_lane, seed_batch_from)
 
 
 def save(path: str, state: SimState) -> None:

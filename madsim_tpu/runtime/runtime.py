@@ -11,6 +11,7 @@ table *inside* the trace; the Python loop only orchestrates jitted calls.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 from typing import Any, Callable, Sequence
@@ -82,6 +83,7 @@ class Runtime:
         self.invariant = invariant
         self.extensions = list(extensions)
         self._halt_when = halt_when
+        self._persist = persist      # kept for derived() re-construction
         if lint:
             # the DetSan construction gate (analyze/lint.py, DESIGN §14):
             # lint=True raises on active findings BEFORE anything traces,
@@ -145,6 +147,47 @@ class Runtime:
         except Exception:
             self.scenario = old
             raise
+
+    def derived(self, **overrides) -> "Runtime":
+        """A Runtime over the SAME world — programs, state spec,
+        node->program map, scenario, invariants, persistence mask,
+        extensions — with config fields replaced. The
+        observability-upgrade constructor window replay rides
+        (obs/timetravel.py, DESIGN §21): derive a big-ring/profiled
+        build of a runtime whose live sweep ran lean, replay a lane
+        checkpoint through it, get the identical trajectory with more
+        instrumentation. Replay-domain overrides (n_nodes, time_limit,
+        jitter gate, ...) are legal too but produce a DIFFERENT replay
+        domain — checkpoints then reject via the world-signature check.
+        Shares the process program cache, so structurally-equal derived
+        runtimes cost zero new compiles."""
+        return Runtime(dataclasses.replace(self.cfg, **overrides),
+                       self.programs, self.state_spec,
+                       node_prog=self.node_prog, scenario=self.scenario,
+                       invariant=self.invariant, persist=self._persist,
+                       halt_when=self._halt_when,
+                       extensions=self.extensions,
+                       share_programs=self._sig is not None)
+
+    def _ckpt_setup(self, ckpt_every, ckpt_log):
+        """Shared ckpt_every/ckpt_log normalization for run()/run_fused:
+        returns (ckpt_every, ckpt_log) or (None, None) when harvesting
+        is off. The log is also stashed as `self.last_ckpt_log` so the
+        sugar form `run(..., ckpt_every=K)` (no explicit log) still
+        hands the harvest back."""
+        if ckpt_every is None and ckpt_log is None:
+            return None, None
+        from ..obs.timetravel import CheckpointLog
+        if ckpt_log is None:
+            ckpt_log = CheckpointLog(every=ckpt_every)
+        if ckpt_every is None:
+            ckpt_every = ckpt_log.every
+        if not ckpt_every or int(ckpt_every) <= 0:
+            raise ValueError("ckpt_every must be a positive step count "
+                             "(or pass a CheckpointLog with .every set)")
+        ckpt_log.signature = self.cfg.structural_signature()
+        self.last_ckpt_log = ckpt_log
+        return int(ckpt_every), ckpt_log
 
     # ------------------------------------------------------------------
     def _build_template(self) -> SimState:
@@ -356,7 +399,8 @@ class Runtime:
         return jax.jit(run, static_argnums=2, donate_argnums=0)
 
     def run_fused(self, state: SimState, max_steps: int,
-                  chunk: int = 512) -> SimState:
+                  chunk: int = 512,
+                  ckpt_every: int | None = None, ckpt_log=None) -> SimState:
         """`run()` without the per-chunk host sync: advance until every
         trajectory halts or ~max_steps events each (rounded up to a chunk
         multiple), as ONE XLA dispatch (see `_fused_runner`).
@@ -377,10 +421,36 @@ class Runtime:
         Input buffers are DONATED — do not reuse `state` after calling.
         Works on sharded, non-addressable batches (it is pure SPMD),
         unlike `run_compacting`.
+
+        ckpt_every / ckpt_log (r20, DESIGN §21): when set, the sweep is
+        segmented into fused dispatches of ~ckpt_every steps each and a
+        per-lane checkpoint (owned host copy of the batch) is harvested
+        at each segment boundary — the boundary IS the sync the harvest
+        needs, so checkpointing adds exactly the syncs it is paid for
+        and the default (off) keeps the single-dispatch shape
+        untouched. Trajectories are bit-identical either way: segments
+        re-enter the same fused executable and frozen lanes are
+        identity (tests/test_timetravel.py holds it).
         """
         n_chunks = -(-max_steps // chunk)
-        return self._fused_runner(state, jnp.asarray(n_chunks, jnp.int32),
-                                  chunk)
+        ckpt_every, ckpt_log = self._ckpt_setup(ckpt_every, ckpt_log)
+        if ckpt_every is None:
+            return self._fused_runner(state,
+                                      jnp.asarray(n_chunks, jnp.int32),
+                                      chunk)
+        seg = max(1, -(-ckpt_every // chunk))     # chunks per segment
+        ckpt_log.harvest(state, steps_done=0)     # entry = zeroth ckpt
+        total = 0
+        while total < n_chunks:
+            m = min(seg, n_chunks - total)
+            state = self._fused_runner(state, jnp.asarray(m, jnp.int32),
+                                       chunk)
+            total += m
+            if bool(state.halted.all()):
+                break
+            if total < n_chunks:   # a post-final harvest is dead weight
+                ckpt_log.harvest(state, steps_done=total * chunk)
+        return state
 
     def run_fused_sharded(self, state: SimState, max_steps: int,
                           chunk: int = 512, mesh=None) -> SimState:
@@ -407,7 +477,8 @@ class Runtime:
         return self.run_fused(shard_batch(state, mesh), max_steps, chunk)
 
     def run(self, state: SimState, max_steps: int, chunk: int = 512,
-            collect_events: bool = False, observer=None):
+            collect_events: bool = False, observer=None,
+            ckpt_every: int | None = None, ckpt_log=None):
         """Advance until every trajectory halts or ~max_steps events each
         (rounded up to a chunk multiple). Returns (state, events|None).
 
@@ -427,7 +498,27 @@ class Runtime:
         `halted.all()` test — no new sync points; the only extra cost is
         transferring the [B] halted lane at a boundary the host was
         blocked on anyway.
+
+        ckpt_every / ckpt_log (r20, DESIGN §21): harvest periodic
+        per-lane checkpoints — an owned host copy of the whole batch —
+        into an `obs.timetravel.CheckpointLog` at the first chunk sync
+        on or past each multiple of `ckpt_every` steps. Harvests ride
+        the per-chunk host sync this runner already pays (no new sync
+        points, the §9 rule); off (the default) costs literally
+        nothing. Pass an explicit log to accumulate across runs, or
+        just `ckpt_every=K` — the auto-created log is also stashed as
+        `self.last_ckpt_log`. Any lane's checkpoint re-seeds via
+        `core.state.seed_batch_from` / `obs.timetravel.replay_window`.
         """
+        ckpt_every, ckpt_log = self._ckpt_setup(ckpt_every, ckpt_log)
+        if ckpt_every is not None:
+            # the ENTRY state is the zeroth checkpoint: with it in the
+            # log, some checkpoint always precedes any causal root, so
+            # time_travel_explain's truncated=False guarantee holds
+            # unconditionally (ring capacity allowing). Costs one host
+            # copy of a state the host just built — no device sync.
+            ckpt_log.harvest(state, steps_done=0)
+        next_harvest = ckpt_every
         # always run full chunks: halted trajectories are frozen by the
         # live-mask gating inside the step, so overshooting max_steps is free
         # and avoids a second XLA compile for a partial tail chunk
@@ -451,6 +542,16 @@ class Runtime:
                 # donated state, like run_compacting's.
                 events.append(jax.tree.map(np.asarray, recs))
             all_halted = bool(state.halted.all())
+            if (ckpt_every is not None and done >= next_harvest
+                    and not all_halted and done < max_steps):
+                # at the sync the halted.all() test just paid; an owned
+                # host copy (utils/hostcopy) — the next runner() call
+                # donates these buffers. An all-halted batch — or the
+                # sweep's final state (done >= max_steps) — is an end
+                # state, not a restart point, so it is never harvested
+                # (run_fused applies the same post-final rule).
+                ckpt_log.harvest(state, steps_done=done)
+                next_harvest = done + ckpt_every
             if observer is not None:
                 t_now = time.perf_counter()
                 observer.on_chunk(dict(
@@ -618,17 +719,13 @@ class Runtime:
         an arbitrary step count never costs an arbitrary-length compile.
         Pair with `find_divergence` / `run_single(collect_events=True)`:
         localize a step, then inspect the full cluster state right there.
+        The one exact-step loop, shared with the r20 replay plane
+        (`obs.timetravel.advance_exact` — this call is the uncapped
+        single-lane case).
         """
-        state = self.init_single(seed)
-        remaining = int(step)
-        runner = self._run_chunk[False]
-        while remaining > 0:
-            c = 1 << (remaining.bit_length() - 1)   # largest pow2 <=
-            state, _ = runner(state, c)
-            remaining -= c
-            if bool(state.halted.all()):   # fixed point: stop scanning
-                break
-        return state
+        from ..obs.timetravel import advance_exact
+        return advance_exact(self, self.init_single(seed), step,
+                             chunk=1 << 30)
 
     # ------------------------------------------------------------------
     # Imperative supervisor surface (Handle::kill/... runtime/mod.rs:200-256)

@@ -71,7 +71,8 @@ class CrashBuckets:
                 round_no: int, worker_id: int, chain: list | None = None,
                 state=None, lane: int | None = None,
                 nudge: int | None = None,
-                last_op: int | None = None) -> tuple[str, bool]:
+                last_op: int | None = None,
+                chain_truncated: bool | None = None) -> tuple[str, bool]:
         """Fold one crash observation in. Returns (bucket key, opened):
         `opened` is True when this observation created a new bucket (and
         wrote its repro + trace artifacts); an observation matching an
@@ -89,7 +90,18 @@ class CrashBuckets:
         attribution; -1 = untouched/bootstrap) into the bucket record —
         the triage plane's per-operator bucket attribution; buckets
         without it (pre-r18, or races) attribute to the explicit
-        `base` class."""
+        `base` class.
+
+        `chain_truncated` (r20) records whether this observation's
+        chain was cut at ring wrap. Completeness UPGRADE rule: an
+        observation matching an existing bucket with a DEEPER (or
+        newly complete) chain — e.g. a time-travel replay
+        (`explain_crash(replay=True)`) recovering the full chain its
+        truncated sibling opened the bucket with — rewrites the
+        bucket's fingerprint/chain in place (deepest-common-suffix
+        already proved them the same bug; the repro handle and key
+        stay canonical). The bucket record therefore converges to the
+        most complete chain any worker ever observed."""
         self.refresh()
         key = self._match(fp)
         opened = key is None
@@ -107,6 +119,8 @@ class CrashBuckets:
                 created_at=time.time())
             if last_op is not None:
                 rec["op"] = int(last_op)
+            if chain_truncated is not None:
+                rec["chain_truncated"] = bool(chain_truncated)
             self.store.write_bucket(key, rec, knobs=knobs)
             if state is not None and lane is not None:
                 from ..obs.trace import export_chrome_trace
@@ -114,6 +128,19 @@ class CrashBuckets:
                     key, ".trace.json"), state=state, lane=int(lane))
             self._index[key] = rec
             self.new_keys.append(key)
+        else:
+            old = self._index[key]["fingerprint"]
+            deeper = (fp["depth"] > old["depth"]
+                      or (fp.get("complete") and not old.get("complete")))
+            if deeper and chain:
+                rec = dict(self._index[key], fingerprint=fp,
+                           chain=[{k: int(c[k]) for k in c}
+                                  for c in chain],
+                           upgraded_at=time.time())
+                if chain_truncated is not None:
+                    rec["chain_truncated"] = bool(chain_truncated)
+                self.store.write_bucket(key, rec)   # no knobs: the
+                self._index[key] = rec              # canonical repro stays
         self.store.append_bucket_log(dict(
             kind="crash", bucket=key, fp_key=fp["key"],
             crash_code=fp["crash_code"], seed=int(seed),
@@ -132,14 +159,17 @@ class CrashBuckets:
             exp = explain_crash(state, lane)
             fp = causal_fingerprint(exp)
             chain = exp["chain"]
+            truncated = bool(exp["truncated"])
         except ValueError:
             code = int(np.asarray(state.crash_code).reshape(-1)[lane])
             node = int(np.asarray(state.crash_node).reshape(-1)[lane])
             fp, chain, state, lane = code_fingerprint(code, node), None, \
                 None, None
+            truncated = None
         return self.observe(fp, seed=seed, knobs=knobs, round_no=round_no,
                             worker_id=worker_id, chain=chain, state=state,
-                            lane=lane, last_op=last_op)
+                            lane=lane, last_op=last_op,
+                            chain_truncated=truncated)
 
 
 def merged_buckets(store: CorpusStore, log: list | None = None) -> list[dict]:

@@ -508,7 +508,8 @@ def supervise_campaign(factory: str, corpus_dir: str, *, workers: int = 2,
 
 def replay_bucket(rt, corpus_dir: str, key: str, max_steps: int,
                   chunk: int = 256, dup_slots: int = 2,
-                  verify: bool | None = None):
+                  verify: bool | None = None,
+                  full_chain: bool = False, window_trace: bool = False):
     """Re-run a bucket's kept repro — the durable analog of pasting a
     madsim seed into a failing test. Returns (crashed, crash_code,
     explain dict or None): the (seed, knobs) handle replays the exact
@@ -526,7 +527,18 @@ def replay_bucket(rt, corpus_dir: str, key: str, max_steps: int,
     campaign workers share one cache dir by design). With verify on,
     the lane re-runs until two consecutive invocations agree on
     (crashed, code, fingerprint); three distinct results raise — real
-    nondeterminism, not the known transient."""
+    nondeterminism, not the known transient.
+
+    full_chain (r20, DESIGN §21): when the replayed crash's chain is
+    truncated at ring wrap — or the runtime compiled the ring out —
+    re-run the handle through `obs.timetravel.full_chain_replay` (the
+    t=0 checkpoint, ring upgraded to hold the whole trajectory) and
+    return the COMPLETE chain instead; the bucket record is upgraded
+    in place when the complete chain matches the bucket
+    (deepest-common-suffix), so triage converges to full chains.
+    window_trace additionally writes the replayed window's focused
+    Perfetto export next to the bucket artifacts
+    (`buckets/<key>.window.trace.json` — service.report links it)."""
     import numpy as np
 
     from ..obs.causal import explain_crash
@@ -556,8 +568,41 @@ def replay_bucket(rt, corpus_dir: str, key: str, max_steps: int,
             what=f"bucket {key}",
             detail=lambda a, b, c: (f"fingerprints {a[1][2]}, {b[1][2]}, "
                                     f"{c[1][2]}"))
-    crashed, code, _ = out
+    crashed, code, fp_seen = out
     exp = None
     if crashed and rt.cfg.trace_cap > 0:
         exp = explain_crash(state, 0)
+    if full_chain and crashed and (exp is None or exp.get("truncated")):
+        from ..obs.causal import causal_fingerprint, fingerprints_match
+        from ..obs.timetravel import full_chain_replay
+        trace_path = (store.bucket_path(key, ".window.trace.json")
+                      if window_trace else None)
+        rep = full_chain_replay(
+            rt, seed=seed, knobs=knobs,
+            expect=dict(crashed=crashed, crash_code=code,
+                        fingerprint=fp_seen),
+            max_steps=max_steps, chunk=chunk,
+            trace_cap=int(np.asarray(state.steps)[0]) + 1,
+            export_trace=trace_path)
+        exp = rep["explain"]
+        # converge the durable record to the complete chain — only when
+        # deepest-common-suffix proves it the same bug as the bucket
+        rec = store.load_bucket(key)
+        new_fp = causal_fingerprint(exp)
+        old_fp = rec["fingerprint"]
+        deeper = (new_fp["depth"] > old_fp["depth"]
+                  or (new_fp.get("complete") and not old_fp.get("complete"))
+                  or rec.get("chain_truncated") is not False)
+        if deeper and fingerprints_match(new_fp, old_fp):
+            rec.update(fingerprint=new_fp,
+                       chain=[{k: int(c[k]) for k in c}
+                              for c in exp["chain"]],
+                       chain_truncated=bool(exp["truncated"]))
+            store.write_bucket(key, rec)
+    elif full_chain and crashed and window_trace:
+        # chain already complete in the live ring: still attach the
+        # focused trace so the bucket row links it
+        from ..obs.trace import export_chrome_trace
+        export_chrome_trace(store.bucket_path(key, ".window.trace.json"),
+                            state=state, lane=0)
     return crashed, code, exp

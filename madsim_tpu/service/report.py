@@ -69,7 +69,7 @@ def render_text(cur: dict, diff: dict | None = None) -> str:
                                  f"recipe={bk.get('recipe', '?')}")
     lines.append(f"{'bucket':<18}{'life':<11}{'code':>5} "
                  f"{'recipe':<15}{'operator':<17}{'obs':>4} "
-                 f"{'rounds':<9}{'audit':<7} repro")
+                 f"{'rounds':<9}{'audit':<7}{'chain':<9} repro")
 
     for k, bk in sorted(cur.get("buckets", {}).items()):
         a = bucket_audit(cur, k, bk.get("members", ()))
@@ -78,13 +78,28 @@ def render_text(cur: dict, diff: dict | None = None) -> str:
                  f"worker={r.get('worker_id')}")
         if bk.get("minimized"):
             repro += " minimized"
+        # r20: is the recorded causal chain the whole story or still
+        # truncated-at-wrap, and does a replayed window trace exist?
+        # (pre-r20 snapshots lack both fields — rendered as "-")
+        if "chain_complete" not in bk:
+            chain = "-"
+        else:
+            chain = "full" if bk["chain_complete"] else "cut"
+            if bk.get("window_trace"):
+                chain += "+tr"
+        if bk.get("window_trace"):
+            # the full member key: this line is the copy-pasteable
+            # repro surface, so the path must be the real filename
+            repro += (f" trace=buckets/{bk['window_trace']}"
+                      ".window.trace.json")
         lines.append(
             f"{k[:16]:<18}{bucket_lifecycle(k, diff):<11}"
             f"{bk['crash_code']:>5} "
             f"{bk['recipe']:<15}{bk['op']:<17}"
             f"{bk['observations']:>4} "
             f"{bk['first_round']}-{bk['last_round']:<7}"
-            f"{(a or {}).get('status', '-'):<7} {repro}")
+            f"{(a or {}).get('status', '-'):<7}"
+            f"{chain:<9} {repro}")
     stale_w = [w for w, h in cur.get("workers_health", {}).items()
                if h.get("stale")]
     if stale_w:

@@ -68,7 +68,9 @@ from .campaign import campaign_timeline
 from .store import CorpusStore, _atomic_bytes
 
 TRIAGE_FORMAT = "madsim-triage"
-TRIAGE_VERSION = 1
+# v2 (r20): bucket rows carry chain_complete + window_trace, audit
+# rows carry chain_complete — additive; v1 snapshots still diff cleanly
+TRIAGE_VERSION = 2
 
 # the explicit unattributable class (accounting contract above)
 BASE_CLASS = "base"
@@ -332,6 +334,11 @@ def triage_snapshot(store_or_dir, quiet_rounds: int = 2) -> tuple[int, dict]:
         recipe_bk[fam] += 1
         op_bk[opn] += 1
         rounds = obs_rounds.get(m["key"], [m["repro"].get("round", 0)])
+        # r20: chain completeness + the replayed-window trace link.
+        # chain_truncated is the recorded truth when present (r20+
+        # observations and time-travel upgrades); older records fall
+        # back to the fingerprint's depth-capped completeness bit.
+        ct = m.get("chain_truncated")
         buckets[m["key"]] = dict(
             crash_code=int(m["crash_code"]),
             crash_node=int(m.get("crash_node", -1)),
@@ -344,7 +351,19 @@ def triage_snapshot(store_or_dir, quiet_rounds: int = 2) -> tuple[int, dict]:
             recipe=fam,
             op=opn,
             repro={k: int(v) for k, v in m["repro"].items()},
-            minimized=bool("minimized" in m))
+            minimized=bool("minimized" in m),
+            chain_complete=((not ct) if ct is not None
+                            else bool(m["fingerprint"].get("complete",
+                                                           False))),
+            # the traced MEMBER key (or None): replay_bucket/audit write
+            # the trace under whichever member they replayed, which is
+            # not always the merged bucket's canonical key — report/
+            # dashboard link the file that actually exists
+            window_trace=next(
+                (k2 for k2 in sorted(m["members"])
+                 if os.path.exists(
+                     store.bucket_path(k2, ".window.trace.json"))),
+                None))
 
     # -- durable timeline curves + worker health ------------------------
     # curves embed DOWNSAMPLED (≤ _CURVE_CAP points, endpoints kept,
@@ -551,7 +570,8 @@ def load_audit(store_or_dir) -> dict:
 
 
 def audit_buckets(rt, store_or_dir, max_steps: int, budget: int = 4,
-                  chunk: int = 512, dup_slots: int = 2) -> dict:
+                  chunk: int = 512, dup_slots: int = 2,
+                  full_chain: bool = False) -> dict:
     """Re-verify a deterministic rotation of bucket repro handles — the
     standing answer to "do our repros still reproduce on this
     toolchain" (and a continuous canary for the known jaxlib
@@ -571,7 +591,15 @@ def audit_buckets(rt, store_or_dir, max_steps: int, budget: int = 4,
     (atomic rewrite); snapshots fold the ledger in, so the dashboard
     always shows the latest verdict per bucket. `budget` bounds replays
     per call — a nightly `budget=4` sweeps a 40-bucket corpus every ten
-    nights, for free."""
+    nights, for free.
+
+    The ledger also records each audited bucket's CHAIN COMPLETENESS
+    (r20): whether its recorded causal chain is complete or still
+    truncated-at-wrap (`chain_complete`). With `full_chain=True` each
+    audited replay additionally runs the time-travel hook
+    (`replay_bucket(full_chain=True, window_trace=True)`) — truncated
+    buckets are upgraded to their complete chain and gain a focused
+    window trace as they rotate through the audit."""
     from ..service.store import StoreMismatch
     from .campaign import replay_bucket
     store = _as_store(store_or_dir)
@@ -593,7 +621,8 @@ def audit_buckets(rt, store_or_dir, max_steps: int, budget: int = 4,
             try:
                 crashed, code, _ = replay_bucket(
                     rt, store.dir, key, max_steps, chunk=chunk,
-                    dup_slots=dup_slots, verify=True)
+                    dup_slots=dup_slots, verify=True,
+                    full_chain=full_chain, window_trace=full_chain)
                 status = "pass" if crashed else "fail"
                 note = None
             except StoreMismatch:
@@ -601,6 +630,8 @@ def audit_buckets(rt, store_or_dir, max_steps: int, budget: int = 4,
             except Exception as e:  # noqa: BLE001 - per-bucket verdict
                 status, code = "flaky", None
                 note = f"{type(e).__name__}: {e}"
+            if full_chain:
+                rec = store.load_bucket(key)   # may have been upgraded
             b = ledger["buckets"].setdefault(
                 key, {"audits": 0, "pass": 0, "fail": 0, "flaky": 0})
             b["audits"] += 1
@@ -608,11 +639,19 @@ def audit_buckets(rt, store_or_dir, max_steps: int, budget: int = 4,
             b["status"] = status
             b["expected_code"] = int(rec["crash_code"])
             b["last_code"] = None if code is None else int(code)
+            # is the bucket's recorded chain the WHOLE story, or still
+            # cut at ring wrap? (pre-r20 records without the flag fall
+            # back to the fingerprint's depth-capped completeness bit)
+            ct = rec.get("chain_truncated")
+            b["chain_complete"] = (
+                (not ct) if ct is not None
+                else bool(rec["fingerprint"].get("complete", False)))
             if note is not None:
                 b["note"] = note
             elif "note" in b:
                 del b["note"]
-            audited.append(dict(bucket=key, status=status, code=code))
+            audited.append(dict(bucket=key, status=status, code=code,
+                                chain_complete=b["chain_complete"]))
         ledger["cursor_key"] = todo[-1]
         ledger.pop("cursor", None)
     os.makedirs(store.triage_dir(), exist_ok=True)

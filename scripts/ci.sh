@@ -24,6 +24,14 @@ tier=${1:-fast}
 # recompiling every structurally-known step program. Content-keyed — it
 # can only skip the compile stage, never change results.
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+# ... with the SAME 5s floor tests/conftest.py applies (ROADMAP r12/r16:
+# small deserialized executables can corrupt on first invocation — the
+# floor keeps them out of the cache). Without this, the bench smokes
+# below run floor-less (jax's default floor is 1s) and re-seed the
+# shared cache with exactly the small high-traffic executables the
+# pytest floor exists to exclude — the suite then deserializes them and
+# the r16-era masked-digest flake returns.
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-5}"
 # pytest prints the compile-counter summary at suite end (tests/conftest.py)
 export MADSIM_COMPILE_SUMMARY="${MADSIM_COMPILE_SUMMARY:-1}"
 
@@ -93,6 +101,13 @@ case "$tier" in
     # totals), the standing HTML dashboard must render, and the
     # repro-health audit must record a verdict via replay_bucket
     python bench.py --triage-smoke
+    # time-travel smoke: a crash recorded with a wrapped 4-slot ring
+    # must replay from a harvested checkpoint to a complete
+    # (truncated=False) causal chain, bit-stably twice, staying
+    # bucket-compatible with the live truncated observation; and the
+    # divergence microscope must name the same first divergent
+    # dispatch on a re-run of the same lane pair
+    python bench.py --tt-smoke
     # regression gate (OSS-Fuzz-style): every committed crash bucket in
     # tests/data/regression_corpus must still reproduce (run-twice
     # verified) and the top-energy corpus slice must still land on its
