@@ -439,7 +439,7 @@ def _sched_ab_mode():
 
 
 def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0,
-                        profile=False, latency_hist=0):
+                        profile=False, latency_hist=0, series_windows=0):
     """A deliberately tiny workload (2-node ping-pong, C=16, P=2, stats
     off) for the fused A/B: per-step device compute is small, so the
     per-chunk host round-trip the chunked runner pays
@@ -456,6 +456,7 @@ def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0,
                     time_limit=sec(590), collect_stats=False,
                     trace_cap=trace_cap, sketch_slots=sketch_slots,
                     profile=profile, latency_hist=latency_hist,
+                    series_windows=series_windows,
                     # ping deliveries as completions so the lat_ab
                     # variants pay the e2e fold, not just the sojourn
                     complete_kinds=(((EV_MSG, 1),) if latency_hist
@@ -848,6 +849,60 @@ def _make_connfault_runtime(recipe="mix", trace_cap=128, n_txns=6,
                                   send_latency_max=ms(8)))
     return make_minipg_runtime(n_clients=2, n_txns=n_txns, scenario=sc,
                                cfg=cfg, epoch_guard=guard)
+
+
+def _make_recovery_runtime(recipe="heal", invariant=None, target=400):
+    """The recovery-oracle flagship targets (r21, DESIGN §22): rpc_echo
+    with the latency + series planes on, under fault scripts whose
+    timeline shape `harness.recovery_invariant` judges. One canonical
+    definition — --series-smoke, the series_ab burst-energy A/B, and
+    tests/test_series.py import it.
+
+      heal    clog the server at 1.2s, unclog at 2.6s — the cure is
+              OP_UNCLOG (SRF_HEAL, which does NOT restart the recovery
+              clock), so the post-heal windows are GENUINELY judged and
+              green. The fuzz regime: mutants that move the unclog out
+              of the timeline, fatten the recovered floor, or re-clog
+              late fail to return to envelope -> CRASH_RECOVERY
+      noheal  fatten the network at 1.2s (set_latency: SRF_NET) and
+              never recover — every judged window past the grace
+              period stays degraded, the oracle fires deterministically
+              at the first judged window boundary
+
+    Window arithmetic the recipes lean on: window_len=625ms x W=8
+    covers the 5s timeline (time_limit 6s; the tail clamps into w7).
+    The fault lands in w1, so within=4 starts judging at w5 — past the
+    heal recipe's recovery spike in w4 (pent-up retries complete with
+    e2e ~= the clog span; root_kinds can't re-mint while the server is
+    dark). target=400 echoes/client keeps lanes alive past w7's
+    completion (5s) and halts them before the 6s limit, so green lanes
+    judge w5-w7 non-vacuously."""
+    from madsim_tpu import (NetConfig, Runtime, Scenario, SimConfig, ms,
+                            sec)
+    from madsim_tpu.core.types import EV_MSG
+    from madsim_tpu.models.rpc_echo import TAG_ECHO, make_echo_runtime
+    from madsim_tpu.net import rpc
+    rtag = rpc.reply_tag(TAG_ECHO)
+    sc = Scenario()
+    if recipe == "heal":
+        sc.at(ms(1200)).clog_node(0)
+        sc.at(ms(2600)).unclog_node(0)
+    else:
+        assert recipe == "noheal", recipe
+        sc.at(ms(1200)).set_latency(ms(30), ms(60))
+    cfg = SimConfig(n_nodes=4, event_capacity=64, time_limit=sec(6),
+                    latency_hist=24, trace_cap=512,
+                    series_windows=8, window_len=ms(625),
+                    complete_kinds=((EV_MSG, rtag),),
+                    root_kinds=((EV_MSG, rtag),),
+                    net=NetConfig(send_latency_min=ms(1),
+                                  send_latency_max=ms(8)))
+    rt = make_echo_runtime(n_nodes=4, target=target, scenario=sc, cfg=cfg)
+    if invariant is not None:
+        rt = Runtime(cfg, rt.programs, rt.state_spec,
+                     node_prog=rt.node_prog, scenario=sc,
+                     invariant=invariant, halt_when=rt._halt_when)
+    return rt
 
 
 def _search_ab_mode():
@@ -2510,6 +2565,293 @@ def _lat_smoke_mode():
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
+def _series_ab_mode():
+    """--mode series_ab: windowed-telemetry-plane overhead A/B on the
+    fused runner — the obs_ab/lat_ab protocol exactly (worst-case tiny
+    step, interleaved min-of-9 reps). Three builds, identical
+    trajectories by construction (the window writes consume no
+    randomness):
+
+      off            series_windows=0 — plane compiled out (baseline)
+      series_masked  series_windows=8 compiled in, NO lanes recording —
+                     the cost of carrying the sr_* columns and the
+                     masked one-hot window folds; the ship-with-it
+                     shape, bar <= 3% at B=512
+      series_on      every lane records (the ceiling)
+
+    Also A/Bs burst-guided corpus energy (Corpus.burst_bonus, fed by
+    stats.lane_burst's deepest-transient-spike signal) against uniform
+    energy at EQUAL budget on the heal-bearing recovery flagship — the
+    regime where the interesting mutants are the ones that spike
+    deepest before (failing to) recover — reporting each side's
+    distinct-schedule coverage and whether the campaign opened a
+    CRASH_RECOVERY bucket whose (seed, knobs) handle replays red.
+    Writes BENCH_series_ab_<platform>.json next to this file."""
+    _preflight_or_cpu("--series-ab")
+    import jax
+    from madsim_tpu import CRASH_RECOVERY, fuzz, ms, recovery_invariant
+    platform = jax.devices()[0].platform
+    B, steps, chunk, reps = 512, 2048, 256, 9
+    variants = (("off", 0, None), ("series_masked", 8, []),
+                ("series_on", 8, None))
+    out = {"metric": "series_ab", "platform": platform, "batch": B,
+           "steps": steps, "chunk": chunk, "reps": reps,
+           "note": ("tiny 2-node workload = worst case for relative "
+                    "series-plane overhead (fixed per-step window folds "
+                    "vs tiny step); fused runner, lanes never halt, "
+                    "identical step counts per variant; reps "
+                    "interleaved round-robin, min-of-reps. "
+                    "series_masked and series_on execute identical "
+                    "compute (masked folds run either way) — spread "
+                    "between them is the noise floor. Bar: "
+                    "series_masked <= 3% MODULO this host's cross-run "
+                    "envelope (the causal_ab/lat_ab caveat, DESIGN "
+                    "§12): single-run numbers cannot resolve 3% on a "
+                    "shared CPU; read overhead_series_program (pooled "
+                    "best over the identical-compute builds)"),
+           "variants": {}}
+    seeds = np.arange(B)
+    by_w = {w: _make_light_runtime(series_windows=w)
+            for w in {w for _, w, _ in variants}}
+    rts, kws = {}, {}
+    for name, w, lanes in variants:
+        rts[name] = by_w[w]
+        kws[name] = ({} if not w or lanes is None
+                     else {"series_lanes": lanes})
+    for rt in by_w.values():
+        jax.block_until_ready(
+            rt.run_fused(rt.init_batch(seeds), steps, chunk).now)
+    best = {name: float("inf") for name, _, _ in variants}
+    for _ in range(reps):
+        for name, _, _ in variants:
+            state = rts[name].init_batch(seeds, **kws[name])
+            jax.block_until_ready(state.now)
+            t0 = time.perf_counter()
+            final = rts[name].run_fused(state, steps, chunk)
+            jax.block_until_ready(final.now)
+            best[name] = min(best[name], time.perf_counter() - t0)
+    eps = {name: B * steps / b for name, b in best.items()}
+    for name, _, _ in variants:
+        out["variants"][name] = round(eps[name], 1)
+        print(f"--series-ab: {name} {eps[name]:,.0f} seed-events/s",
+              file=sys.stderr)
+    for name in ("series_masked", "series_on"):
+        out[f"overhead_{name}"] = round(eps["off"] / eps[name] - 1, 4)
+    # series_masked and series_on run the SAME executable on different
+    # sr_on values (masked folds execute either way), so their pooled
+    # best is the honest program cost vs off — the causal_ab precedent
+    # (DESIGN §12) for hosts whose per-variant spread exceeds the bar
+    pooled = max(eps["series_masked"], eps["series_on"])
+    out["overhead_series_program"] = round(eps["off"] / pooled - 1, 4)
+
+    # burst-guided vs uniform corpus energy at equal budget on the
+    # heal-bearing recovery flagship: the burst signal (deepest
+    # per-window p99 spike) concentrates mutation budget on the lanes
+    # that degrade hardest — exactly the neighborhood of the
+    # failed-recovery mutants the oracle crashes
+    inv = recovery_invariant(p99_le=ms(20), within=4, min_count=8)
+    be = {"rounds": 5, "batch": 64, "max_steps": 40000}
+    warm = _make_recovery_runtime("heal", invariant=inv)
+    fuzz(warm, max_steps=40000, batch=64, max_rounds=2, dry_rounds=3,
+         chunk=512)
+    for side, bonus in (("uniform", 0.0), ("burst", 1.0)):
+        rt = _make_recovery_runtime("heal", invariant=inv)
+        t0 = time.perf_counter()
+        res = fuzz(rt, max_steps=40000, batch=64, max_rounds=5,
+                   dry_rounds=6, chunk=512, burst_bonus=bonus)
+        rep = res["crash_repros"].get(CRASH_RECOVERY)
+        side_out = {"distinct_schedules": res["distinct_schedules"],
+                    "recovery_bucket": rep is not None,
+                    "wall_s": round(time.perf_counter() - t0, 2)}
+        if rep is not None:
+            from madsim_tpu.search.mutate import apply_repro_knobs
+            st = rt.init_batch(np.asarray([rep["seed"]], np.uint32))
+            st, _ = apply_repro_knobs(rt, st, rep["knobs"])
+            fin = rt.run_fused(st, 60000, 512)
+            side_out["recovery_repro"] = {
+                "seed": rep["seed"], "round": rep["round"],
+                "replay_code": int(np.asarray(fin.crash_code)[0])}
+        be[side] = side_out
+        print(f"--series-ab: energy/{side} "
+              f"{res['distinct_schedules']} schedules, recovery bucket: "
+              f"{side_out['recovery_bucket']}", file=sys.stderr)
+    be["burst_vs_uniform"] = round(
+        be["burst"]["distinct_schedules"]
+        / max(be["uniform"]["distinct_schedules"], 1), 3)
+    out["burst_energy"] = be
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_series_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _series_smoke_mode():
+    """--series-smoke: seconds-scale windowed-telemetry self-test for CI
+    (wired into scripts/ci.sh fast):
+
+      1. on a direct-reply rpc_echo workload whose full-size ring holds
+         the complete history, every lane's device series must EQUAL a
+         host replay of the ring bucketed by the window rule
+         (min(now // window_len, W-1)): per-(window, node) dispatches,
+         per-window completions, and the per-window latency histograms;
+      2. the plane must be free of trajectory influence: fingerprints
+         equal across on/masked/compiled-out, fused == chunked on every
+         trace column, masked lanes accumulate nothing;
+      3. the batch-merged series digest must be the exact sum/max of
+         the recording lanes' columns, and drop to zero lanes when all
+         are masked;
+      4. the recovery-oracle roundtrip on the canonical flagship
+         (_make_recovery_runtime): the healed recipe stays green with
+         its post-heal windows genuinely judged, the unhealed recipe
+         crashes CRASH_RECOVERY twice identically with equal
+         fingerprints, and the single-lane seed replay crashes red too;
+      5. the Perfetto export must carry TRUE sim-time counter tracks
+         (queue_depth / e2e_p99 / fault at window-start timestamps);
+      6. a burst-guided fuzz campaign over the heal-bearing recipe must
+         open a CRASH_RECOVERY bucket whose (seed, knobs) handle
+         replays red.
+
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import json as _json
+    import tempfile
+    from madsim_tpu import (CRASH_RECOVERY, NetConfig, SimConfig, fuzz,
+                            ms, sec, recovery_invariant)
+    from madsim_tpu.core.state import TRACE_FIELDS
+    from madsim_tpu.core.types import EV_MSG
+    from madsim_tpu.models.rpc_echo import TAG_ECHO, make_echo_runtime
+    from madsim_tpu.net import rpc
+    from madsim_tpu.obs import (export_profile_trace, format_series,
+                                ring_records, series_summary)
+    from madsim_tpu.parallel.stats import series_counters
+    t0 = time.perf_counter()
+    rtag = rpc.reply_tag(TAG_ECHO)
+    seeds = np.arange(8, dtype=np.uint32)
+
+    def make_small(windows):
+        cfg = SimConfig(n_nodes=4, event_capacity=64, time_limit=sec(3),
+                        latency_hist=24 if windows else 0,
+                        trace_cap=2048 if windows else 0,
+                        series_windows=windows, window_len=ms(150),
+                        complete_kinds=((EV_MSG, rtag),) if windows
+                        else (),
+                        root_kinds=((EV_MSG, rtag),) if windows else (),
+                        net=NetConfig(send_latency_min=ms(1),
+                                      send_latency_max=ms(8)))
+        return make_echo_runtime(n_nodes=4, target=40, cfg=cfg)
+
+    # 1+2: device series == host ring replay; bit-identity on/masked/off
+    rt = make_small(4)
+    rt_off = make_small(0)
+    chunked, _ = rt.run(rt.init_batch(seeds), 8192, 512)
+    fused = rt.run_fused(rt.init_batch(seeds), 8192, 512)
+    masked = rt.run_fused(rt.init_batch(seeds, series_lanes=[]),
+                          8192, 512)
+    off, _ = rt_off.run(rt_off.init_batch(seeds), 8192, 512)
+    assert (rt.fingerprints(chunked) == rt.fingerprints(fused)).all()
+    assert (rt.fingerprints(chunked) == rt.fingerprints(masked)).all()
+    assert (rt.fingerprints(chunked) == rt_off.fingerprints(off)).all(), \
+        "series plane perturbed the trajectory"
+    for f in TRACE_FIELDS:
+        assert (np.asarray(getattr(chunked, f))
+                == np.asarray(getattr(fused, f))).all(), f
+    for f in ("sr_dispatch", "sr_busy", "sr_qhw", "sr_drop", "sr_dup",
+              "sr_complete", "sr_slo_miss", "sr_lat", "sr_fault"):
+        assert not np.asarray(getattr(masked, f)).any(), f
+    W, wl = 4, ms(150)
+    disp = np.asarray(chunked.sr_dispatch)     # [B, W, N]
+    comp = np.asarray(chunked.sr_complete)     # [B, W]
+    slat = np.asarray(chunked.sr_lat)          # [B, W, LB]
+    replayed = 0
+    for b in range(len(seeds)):
+        recs = ring_records(chunked, b)
+        assert recs["dropped"] == 0, "ring must hold the whole history"
+        w_of = np.minimum(np.asarray(recs["now"]) // wl, W - 1)
+        ref_d = np.zeros(disp.shape[1:], np.int64)
+        for w, n in zip(w_of, np.asarray(recs["node"])):
+            ref_d[int(w), int(n)] += 1
+        assert (disp[b] == ref_d).all(), (b, disp[b], ref_d)
+        lat = np.asarray(recs["lat"])
+        done = lat >= 0
+        ref_c = np.zeros(W, np.int64)
+        ref_l = np.zeros(slat.shape[1:], np.int64)
+        for w, v in zip(w_of[done], lat[done]):
+            ref_c[int(w)] += 1
+            bkt = (0 if v == 0
+                   else min(int(v).bit_length(), slat.shape[2] - 1))
+            ref_l[int(w), bkt] += 1
+        assert (comp[b] == ref_c).all(), (b, comp[b], ref_c)
+        assert (slat[b] == ref_l).all(), b
+        replayed += int(done.sum())
+    assert replayed > 0
+
+    # 3: batch merge == sum/max over recording lanes; masked drops out
+    c = series_counters(chunked)
+    assert c is not None and c["lanes"] == len(seeds)
+    assert (np.asarray(c["dispatch"]) == disp.sum(0)).all()
+    assert (np.asarray(c["complete"]) == comp.sum(0)).all()
+    assert (np.asarray(c["qhw"])
+            == np.asarray(chunked.sr_qhw).max(0)).all()
+    cm = series_counters(masked)
+    assert cm["lanes"] == 0 and not np.asarray(cm["dispatch"]).any()
+    table = format_series(series_summary(chunked))
+    assert "p99_us" in table
+
+    # 4: recovery-oracle roundtrip on the canonical flagship
+    inv = recovery_invariant(p99_le=ms(20), within=4, min_count=8)
+    rt_green = _make_recovery_runtime("heal", invariant=inv)
+    g1 = rt_green.run_fused(rt_green.init_batch(seeds), 60000, 512)
+    assert (np.asarray(g1.crash_code) == 0).all(), \
+        np.asarray(g1.crash_code)
+    # green lanes outlive the full window timeline — the post-heal
+    # windows were genuinely judged, not skipped
+    assert (np.asarray(g1.now) >= 8 * ms(625)).all()
+    from madsim_tpu.core.types import SRF_HEAL, SRF_PARTITION
+    fw = np.asarray(g1.sr_fault)[0]
+    assert fw[1] & SRF_PARTITION and fw[4] & SRF_HEAL, fw
+    rt_red = _make_recovery_runtime("noheal", invariant=inv)
+    r1 = rt_red.run_fused(rt_red.init_batch(seeds), 60000, 512)
+    r2 = rt_red.run_fused(rt_red.init_batch(seeds), 60000, 512)
+    codes = np.asarray(r1.crash_code)
+    assert (codes == CRASH_RECOVERY).all(), codes
+    assert (np.asarray(r2.crash_code) == codes).all()
+    assert (rt_red.fingerprints(r1) == rt_red.fingerprints(r2)).all()
+    single, _ = rt_red.run_single(int(seeds[3]), 60000, 512)
+    assert int(np.asarray(single.crash_code)[0]) == CRASH_RECOVERY
+
+    # 5: true sim-time counter tracks next to the instants
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "series.json")
+        export_profile_trace(p, g1, lane=0)
+        with open(p) as f:
+            doc = _json.load(f)
+        cevs = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+        names = {e["name"] for e in cevs}
+        assert {"queue_depth", "e2e_p99", "fault"} <= names, names
+        qd = [e["ts"] for e in cevs if e["name"] == "queue_depth"]
+        assert qd == sorted(qd) and qd[1] - qd[0] == ms(625), qd[:3]
+
+    # 6: burst-guided fuzz opens a CRASH_RECOVERY bucket, replays red
+    rt_fz = _make_recovery_runtime("heal", invariant=inv)
+    res = fuzz(rt_fz, max_steps=40000, batch=64, max_rounds=3,
+               dry_rounds=4, chunk=512, burst_bonus=1.0)
+    rep = res["crash_repros"].get(CRASH_RECOVERY)
+    assert rep is not None, sorted(res["crash_repros"])
+    from madsim_tpu.search.mutate import apply_repro_knobs
+    st = rt_fz.init_batch(np.asarray([rep["seed"]], np.uint32))
+    st, _ = apply_repro_knobs(rt_fz, st, rep["knobs"])
+    fin = rt_fz.run_fused(st, 60000, 512)
+    assert int(np.asarray(fin.crash_code)[0]) == CRASH_RECOVERY
+    print(_json.dumps({
+        "metric": "series_smoke", "platform": "cpu", "ok": True,
+        "lanes_checked": int(len(seeds)),
+        "ring_replayed_completions": int(replayed),
+        "recovery_repro": {"seed": rep["seed"], "round": rep["round"]},
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
 def _causal_ab_mode():
     """--mode causal_ab: causal-lineage + prefix-sketch overhead A/B on
     the fused runner, same protocol as obs_ab (interleaved min-of-reps
@@ -3326,7 +3668,8 @@ def main():
                  "--causal-ab", "--causal-smoke", "--campaign",
                  "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
                  "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke",
-                 "--lat-ab", "--lat-smoke", "--grayfail-smoke",
+                 "--lat-ab", "--lat-smoke", "--series-ab",
+                 "--series-smoke", "--grayfail-smoke",
                  "--regression-smoke", "--triage-smoke", "--conn-smoke",
                  "--tt-ab", "--tt-smoke"}
         if flag not in known:
@@ -3359,6 +3702,12 @@ def main():
         return
     if "--prof-smoke" in sys.argv:
         _prof_smoke_mode()
+        return
+    if "--series-ab" in sys.argv:
+        _series_ab_mode()
+        return
+    if "--series-smoke" in sys.argv:
+        _series_smoke_mode()
         return
     if "--lat-ab" in sys.argv:
         _lat_ab_mode()

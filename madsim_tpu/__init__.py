@@ -16,6 +16,7 @@ from .core.state import (CheckpointMismatch, LaneCheckpoint, SimState,
 from .core.types import (
     CRASH_DEADLOCK,
     CRASH_INVARIANT,
+    CRASH_RECOVERY,
     CRASH_SLO,
     CRASH_TIME_LIMIT,
     EV_MSG,
@@ -42,15 +43,19 @@ from .obs import (
     export_profile_trace,
     format_latency,
     format_profile,
+    format_series,
     full_chain_replay,
+    lane_series,
     latency_summary,
     profile_summary,
     replay_window,
     ring_records,
+    series_summary,
 )
 from .harness.minimize import minimize_scenario
 from .harness.simtest import (DetSanFailure, SimFailure, detsan_check,
                               run_seeds, simtest)
+from .harness.recovery import recovery_invariant
 from .harness.slo import slo_invariant
 from .parallel.explore import explore
 from .parallel.stats import (divergence_profile, schedule_representatives,
@@ -70,6 +75,7 @@ __all__ = [
     "Runtime", "Scenario", "simtest", "run_seeds", "SimFailure", "ms", "sec",
     "NODE_RANDOM", "EV_MSG", "EV_TIMER", "EV_SUPER", "CRASH_DEADLOCK",
     "CRASH_TIME_LIMIT", "CRASH_INVARIANT", "CRASH_SLO", "slo_invariant",
+    "CRASH_RECOVERY", "recovery_invariant",
     "explore", "minimize_scenario", "summarize", "schedule_representatives",
     "find_divergence",
     "fuzz", "fuzz_sharded", "Corpus", "KnobPlan", "pct_sweep",
@@ -78,6 +84,7 @@ __all__ = [
     "export_chrome_trace", "explain_crash", "divergence_profile",
     "profile_summary", "format_profile", "export_profile_trace",
     "latency_summary", "format_latency",
+    "series_summary", "format_series", "lane_series",
     "CorpusStore", "run_campaign", "supervise_campaign", "campaign_report",
     "merged_buckets", "replay_bucket",
     "triage_snapshot", "triage_diff", "audit_buckets",

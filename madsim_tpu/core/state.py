@@ -32,9 +32,11 @@ from . import types as T
 # causal-lineage layer (r10 — rides the same gate), the
 # prefix-coverage sketch (cfg.sketch_slots), the sim-profiler
 # counter plane (cfg.profile, r15 — the pf_* columns + the tr_qlen
-# ring column), and the SLO latency plane (cfg.latency_hist, r16 —
+# ring column), the SLO latency plane (cfg.latency_hist, r16 —
 # the lh_* histograms, the ev_root_t root-birth-time column, and the
-# tr_lat ring column). One schema constant so every consumer follows it
+# tr_lat ring column), and the windowed telemetry plane
+# (cfg.series_windows, r21 — the sr_* per-window series and the
+# dynamic window_len operand). One schema constant so every consumer follows it
 # automatically: excluded from fingerprints (utils/hashing —
 # observation only, never a replay domain), read by obs/rings.py (the
 # tr_* columns) and obs/profiler.py (the pf_* columns), compared
@@ -53,6 +55,9 @@ TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
                 "pf_qmax", "pf_drop", "pf_delay",
                 "lh_on", "ev_root_t", "lh_sojourn", "lh_e2e",
                 "lh_slo_miss", "slo_target",
+                "sr_on", "window_len", "sr_dispatch", "sr_busy", "sr_qhw",
+                "sr_drop", "sr_dup", "sr_complete", "sr_slo_miss",
+                "sr_lat", "sr_fault",
                 "hash_base")
 # hash_base rides TRACE_FIELDS for the fingerprint-exclusion contract
 # only: it is a CONSTANT pure function of the lane's seed (never
@@ -347,6 +352,50 @@ class SimState:
                             # (cfg.slo_target seeds it; retune/fuzz
                             # without recompile, like tlimit)
 
+    # --- windowed telemetry plane (cfg.series_windows; obs/series.py) -----
+    # Per-lane sim-time metric SERIES resident in SimState (DESIGN §22):
+    # window w covers virtual ticks [w*window_len, (w+1)*window_len),
+    # events past W*window_len clamp into the last window. Written
+    # through the step's one-hot dispatch machinery like the pf_*/lh_*
+    # planes; SATURATING; observation only (TRACE_FIELDS — no
+    # randomness, no non-series state, excluded from fingerprints;
+    # zero-size when compiled out). Answers WHEN, not just how much:
+    # a brownout during a partition window, a queue that spikes and
+    # drains, a system that never recovers after heal.
+    sr_on: jax.Array        # bool — lane gate (init_batch(series_lanes=))
+    window_len: jax.Array   # int32 ticks per window — DYNAMIC operand
+                            # (cfg.window_len seeds it; retune without
+                            # recompile via Runtime.set_window_len)
+    sr_dispatch: jax.Array  # int32[W, N] — dispatches by (window,
+                            # acting node); supervisor ops count at the
+                            # node _apply_super resolved (the pf_dispatch
+                            # attribution rule)
+    sr_busy: jax.Array      # int32[W, N] — busy virtual ticks by
+                            # (window, acting node): each dispatch's
+                            # now-delta lands in the window it ended in
+    sr_qhw: jax.Array       # int32[W] — event-table occupancy
+                            # high-water inside the window (dispatch +
+                            # emission time, the pf_qmax rule per window)
+    sr_drop: jax.Array      # int32[W] — messages lost in the window
+                            # (send-side clog/loss + dead-node delivery)
+    sr_dup: jax.Array       # int32[W] — duplicate re-arms fired in the
+                            # window (the r19 dup-storm axis over time)
+    sr_complete: jax.Array  # int32[W] — completions (cfg.complete_kinds)
+                            # dispatched in the window; stays zero when
+                            # the latency plane is off
+    sr_slo_miss: jax.Array  # int32[W] — completions over slo_target in
+                            # the window
+    sr_lat: jax.Array       # int32[W, B] — per-window e2e log2
+                            # histograms (the per-window p99 source for
+                            # the recovery oracle and the sim-time
+                            # counter tracks). Compiled in only when
+                            # BOTH this plane and cfg.latency_hist are;
+                            # zero-size otherwise
+    sr_fault: jax.Array     # int32[W] — SRF_* bitmask of fault classes
+                            # that landed in the window (OR-accumulated,
+                            # never saturates) — the recovery oracle's
+                            # "last disturbed window" axis
+
     # --- extension state (plugin framework analog, plugin.rs) -------------
     ext: Any                # dict: extension name -> its state subtree
 
@@ -451,6 +500,23 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
                           cfg.latency_hist), i32),
         lh_slo_miss=jnp.zeros((N if cfg.latency_hist > 0 else 0,), i32),
         slo_target=jnp.asarray(cfg.slo_target, i32),
+        # windowed-telemetry default: every lane records (when compiled
+        # in); init_batch(series_lanes=...) narrows. Zero-size [W]/[W, .]
+        # columns at series_windows == 0; window_len stays a scalar
+        # operand either way (never read then — the trace_pos shape
+        # discipline). sr_lat needs BOTH gates, like tr_lat.
+        sr_on=jnp.asarray(cfg.series_windows > 0),
+        window_len=jnp.asarray(cfg.window_len, i32),
+        sr_dispatch=jnp.zeros((cfg.series_windows, N), i32),
+        sr_busy=jnp.zeros((cfg.series_windows, N), i32),
+        sr_qhw=jnp.zeros((cfg.series_windows,), i32),
+        sr_drop=jnp.zeros((cfg.series_windows,), i32),
+        sr_dup=jnp.zeros((cfg.series_windows,), i32),
+        sr_complete=jnp.zeros((cfg.series_windows,), i32),
+        sr_slo_miss=jnp.zeros((cfg.series_windows,), i32),
+        sr_lat=jnp.zeros((cfg.series_windows if cfg.latency_hist > 0
+                          else 0, cfg.latency_hist), i32),
+        sr_fault=jnp.zeros((cfg.series_windows,), i32),
         ext=ext_state if ext_state is not None else {},
     )
 
@@ -488,6 +554,9 @@ _CKPT_PLANES = {
                 "pf_qmax", "pf_drop", "pf_delay"),
     "latency": ("lh_on", "ev_root_t", "lh_sojourn", "lh_e2e",
                 "lh_slo_miss", "slo_target"),
+    "series": ("sr_on", "window_len", "sr_dispatch", "sr_busy", "sr_qhw",
+               "sr_drop", "sr_dup", "sr_complete", "sr_slo_miss",
+               "sr_lat", "sr_fault"),
 }
 
 # the WORLD slice of a structural signature: the fields two runtimes
@@ -498,9 +567,12 @@ _CKPT_PLANES = {
 # distinct replay domain). The OBSERVABILITY fields (trace bucket,
 # sketch_slots, profile, latency_hist, complete/root kinds) and the
 # emission_write lowering are deliberately excluded: differing there is
-# the point of window replay. Indexes into the simconfig-v6 tuple
-# (types.SimConfig.structural_signature); the version string at [0]
-# keeps the indexing honest across future signature revisions.
+# the point of window replay. Indexes into the simconfig-v7 tuple
+# (types.SimConfig.structural_signature — v7 appended series_windows at
+# the END, so these indices still name the same world fields); the
+# version string at [0] keeps the indexing honest across future
+# signature revisions, and a pre-r21 (v6) checkpoint/store rejects on
+# it automatically.
 _SIG_WORLD_IDX = (0, 1, 2, 3, 4, 6, 9)
 
 _LANE_CKPT_FORMAT = "madsim-lane-ckpt-r20"

@@ -180,8 +180,10 @@ def make_step(
         # Captured here, written in one block after the emission phase:
         # queue depth at dispatch (pre-pop, so the dispatched row counts)
         # and the clock advance this dispatch buys. Pure reductions over
-        # already-computed values — no randomness, no non-pf state.
-        if cfg.profile:
+        # already-computed values — no randomness, no non-pf state. The
+        # windowed telemetry plane (cfg.series_windows, r21) shares both
+        # captures — same values, same transparency contract.
+        if cfg.profile or cfg.series_windows > 0:
             occ_disp = occupied.sum(dtype=jnp.int32)
 
         ev_kind = jnp.where(valid, sel.take1(s.t_kind, idx), T.EV_FREE)
@@ -247,7 +249,7 @@ def make_step(
         # pop the slot; clock never runs backward (resumed nodes' past-due
         # events fire "now", the park/unpark analog of task.rs:134-137)
         now = jnp.where(valid, jnp.maximum(s.now, dmin), s.now)
-        if cfg.profile:
+        if cfg.profile or cfg.series_windows > 0:
             now_delta = now - s.now          # >= 0; 0 when not valid
 
         # ---- SLO latency plane inputs (cfg.latency_hist; DESIGN §17) -----
@@ -675,6 +677,8 @@ def make_step(
                     [(ev_kind == k) & (ev_tag == t)
                      for k, t in cfg.complete_kinds])
                 lat_e2e = jnp.maximum(now - root_measured, 0)
+                lat_e2e_raw = lat_e2e    # pre-sentinel value: the series
+                # plane below folds the completion's latency per WINDOW
                 oh_cpl = sel.row_onehot(cfg.n_nodes, ev_node)  # [N]
                 done_l = is_complete & s.lh_on
                 miss = (done_l & (s.slo_target > 0)
@@ -710,6 +714,96 @@ def make_step(
                 jnp.clip(ck - 1, 0, cfg.sketch_slots - 1)) & at_ck
             s = s.replace(cov_sketch=jnp.where(
                 oh_ck, s.sched_hash[0] ^ s.sched_hash[1], s.cov_sketch))
+
+        # ---- windowed telemetry plane (cfg.series_windows; DESIGN §22) ---
+        # Fold this dispatch into its sim-time WINDOW: the dispatch's
+        # post-advance `now` picks window min(now // window_len, W-1) —
+        # a dispatch exactly ON a boundary opens the next window, events
+        # past W*window_len clamp into the last one. window_len is a
+        # DYNAMIC operand (retune without recompile, the trace_cap/
+        # sketch_every discipline); only the window COUNT shapes the
+        # program. One [W] one-hot (and one [W, N] outer product for the
+        # per-node series) of saturating writes over values the step
+        # already computed — no randomness, no non-series state, so
+        # trajectories are bit-identical across the knob and the sr_*
+        # columns ride TRACE_FIELDS out of fingerprints. Runs BEFORE the
+        # end-condition checks so an `invariant=` (e.g.
+        # harness.recovery_invariant) sees this dispatch's window.
+        if cfg.series_windows > 0:
+            SW = cfg.series_windows
+            rec_s = valid & s.sr_on
+            w_idx = jnp.minimum(now // jnp.maximum(s.window_len, 1),
+                                SW - 1)
+            oh_w = sel.row_onehot(SW, w_idx)                  # [W]
+            # acting-node attribution: the _apply_super-resolved target
+            # for supervisor ops (the pf_dispatch/pf_busy rule)
+            act_s = jnp.where(is_super, reset_target, ev_node)
+            oh_ns = sel.row_onehot(cfg.n_nodes, act_s)        # [N]
+            cell = oh_w[:, None] & oh_ns[None, :] & rec_s     # [W, N]
+            # fault-marker word: which fault classes landed in this
+            # window (SRF_* bits, types.py). Kill/boot bits require the
+            # op to have been EFFECTIVE (reset_mask); matrix/knob ops
+            # mark on dispatch. OR-accumulated — bits, not counts.
+            eff_kill = reset_mask & ((op == T.OP_KILL)
+                                     | (op == T.OP_RESTART))
+            eff_boot = reset_mask & ((op == T.OP_INIT)
+                                     | (op == T.OP_RESTART))
+
+            def opin(*ops):
+                return is_super & functools.reduce(
+                    jnp.logical_or, [op == o for o in ops])
+
+            f_bits = (
+                jnp.where(eff_kill, T.SRF_KILL, 0)
+                | jnp.where(eff_boot, T.SRF_BOOT, 0)
+                | jnp.where(opin(T.OP_CLOG_NODE, T.OP_CLOG_LINK,
+                                 T.OP_PARTITION, T.OP_PARTITION_ONEWAY),
+                            T.SRF_PARTITION, 0)
+                | jnp.where(opin(T.OP_HEAL, T.OP_UNCLOG_NODE,
+                                 T.OP_UNCLOG_LINK), T.SRF_HEAL, 0)
+                | jnp.where(opin(T.OP_SET_LOSS, T.OP_SET_LATENCY),
+                            T.SRF_NET, 0)
+                | jnp.where(opin(T.OP_SET_SKEW, T.OP_SET_DISK),
+                            T.SRF_GRAY, 0)
+                | jnp.where(opin(T.OP_RESET_PEER, T.OP_SET_DUP),
+                            T.SRF_CONN, 0))
+            s = s.replace(
+                sr_dispatch=_sat_add(s.sr_dispatch,
+                                     cell.astype(jnp.int32)),
+                sr_busy=_sat_add(s.sr_busy,
+                                 jnp.where(cell, now_delta, 0)),
+                # per-window occupancy high-water: max, never saturates
+                sr_qhw=jnp.where(
+                    oh_w & rec_s,
+                    jnp.maximum(s.sr_qhw,
+                                jnp.maximum(occ_disp, high_water)),
+                    s.sr_qhw),
+                sr_drop=_sat_add(s.sr_drop, jnp.where(
+                    oh_w & rec_s,
+                    delivered_drop + dropped.astype(jnp.int32), 0)),
+                sr_dup=_sat_add(s.sr_dup,
+                                (oh_w & rec_s & dup_fire)
+                                .astype(jnp.int32)),
+                sr_fault=s.sr_fault | jnp.where(oh_w & rec_s, f_bits, 0),
+            )
+            if cfg.latency_hist > 0 and cfg.complete_kinds:
+                # per-window completion/miss counts + e2e histogram —
+                # the same fold as the lh_* plane, bucketed by WINDOW
+                # instead of node, gated on THIS plane's lane mask
+                done_s = is_complete & s.sr_on
+                miss_s = (done_s & (s.slo_target > 0)
+                          & (lat_e2e_raw > s.slo_target))
+                s = s.replace(
+                    sr_complete=_sat_add(s.sr_complete,
+                                         (oh_w & done_s)
+                                         .astype(jnp.int32)),
+                    sr_slo_miss=_sat_add(s.sr_slo_miss,
+                                         (oh_w & miss_s)
+                                         .astype(jnp.int32)),
+                    sr_lat=_sat_add(
+                        s.sr_lat,
+                        (oh_w[:, None] & bucket_oh(lat_e2e_raw)[None, :]
+                         & done_s).astype(jnp.int32)))
 
         # ---- 5. end conditions -------------------------------------------
         # deadlock: nothing can ever run again (madsim task.rs:116 panic)

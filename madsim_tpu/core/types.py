@@ -128,11 +128,36 @@ CRASH_TIME_LIMIT = -2      # virtual-time limit exceeded (set_time_limit)
 CRASH_INVARIANT = -3       # global invariant check failed (generic)
 CRASH_SLO = -4             # tail-latency SLO invariant failed
                            # (harness.slo_invariant over the latency plane)
+CRASH_RECOVERY = -5        # recovery invariant failed: per-window p99/queue
+                           # never returned under threshold within the
+                           # allowed windows after the last fault window
+                           # (harness.recovery_invariant over the windowed
+                           # telemetry plane, DESIGN §22)
 
 # Oops bits (state.oops) — resource-exhaustion flags instead of UB. The
 # reference grows Vecs unboundedly; static shapes require capacities.
 OOPS_EVENT_OVERFLOW = 1    # event table full; an emission was dropped
 OOPS_TIME_OVERFLOW = 2     # virtual clock would exceed int32 ticks
+
+# ---------------------------------------------------------------------------
+# Windowed-telemetry fault-marker bits (SimState.sr_fault, DESIGN §22): each
+# virtual-time window records WHICH fault classes landed in it, so the
+# recovery oracle (harness.recovery_invariant) and the sim-time renderers
+# (obs/series.py) can name the last disturbed window without replaying.
+# KILL counts only when it actually reset a node (the _apply_super
+# reset mask — a NODE_RANDOM kill with no eligible target marks nothing);
+# the matrix/knob ops mark when the scheduled op dispatched.
+# ---------------------------------------------------------------------------
+SRF_KILL = 1          # effective OP_KILL / the kill half of OP_RESTART
+SRF_BOOT = 2          # effective OP_INIT / OP_RESTART boot
+SRF_PARTITION = 4     # OP_CLOG_NODE/CLOG_LINK/PARTITION/PARTITION_ONEWAY
+SRF_HEAL = 8          # OP_HEAL / OP_UNCLOG_NODE / OP_UNCLOG_LINK
+SRF_NET = 16          # OP_SET_LOSS / OP_SET_LATENCY
+SRF_GRAY = 32         # OP_SET_SKEW / OP_SET_DISK (r17 gray-failure knobs)
+SRF_CONN = 64         # OP_RESET_PEER / OP_SET_DUP (r19 connection faults)
+# the DISRUPTIVE subset: what the recovery oracle counts as "a fault
+# happened here" (boot/heal are recovery actions, not disturbances)
+SRF_DISRUPT = SRF_KILL | SRF_PARTITION | SRF_NET | SRF_GRAY | SRF_CONN
 
 
 @dataclasses.dataclass(frozen=True)
@@ -386,6 +411,44 @@ class SimConfig:
     # initial SimState.slo_target in ticks (DYNAMIC knob — the per-lane
     # state field is what the miss counter compares against; 0 disables)
     slo_target: int = 0
+    # windowed telemetry plane (obs/series.py, DESIGN §22): number of
+    # sim-time WINDOWS in the on-device metric series. 0 (default)
+    # compiles the plane out entirely — zero-size columns, no series
+    # code in the step. > 0 adds, per lane, saturating per-window
+    # series written through the step's one-hot dispatch machinery:
+    #   sr_dispatch [W, N]  dispatches by (window, acting node);
+    #   sr_busy     [W, N]  busy virtual ticks by (window, acting node);
+    #   sr_qhw      [W]     event-table occupancy high-water inside the
+    #                       window (dispatch + emission time, the
+    #                       pf_qmax rule per window);
+    #   sr_drop     [W]     messages lost in the window;
+    #   sr_dup      [W]     duplicate re-arms fired in the window;
+    #   sr_complete [W]     request completions (needs latency_hist +
+    #                       complete_kinds — zero otherwise);
+    #   sr_slo_miss [W]     completions over slo_target in the window;
+    #   sr_lat      [W, B]  per-window e2e log2 histograms (compiled in
+    #                       only when BOTH this plane and latency_hist
+    #                       are — the per-window p99 source);
+    #   sr_fault    [W]     SRF_* bitmask of fault classes that landed
+    #                       in the window (the recovery oracle's axis).
+    # A dispatch at virtual time `now` lands in window
+    # min(now // window_len, W - 1): a dispatch exactly ON a window_len
+    # boundary opens the NEXT window, and events past W*window_len
+    # CLAMP into the last window (size W*window_len >= time_limit for
+    # clean tails). Like trace_cap, an observation lever, not a replay
+    # domain: the writes consume no randomness and touch no non-series
+    # state, trajectories are BIT-IDENTICAL across settings, and the
+    # sr_* columns ride TRACE_FIELDS out of fingerprints. Per-lane
+    # masking rides `init_batch(series_lanes=...)`; the window COUNT is
+    # STRUCTURAL (it shapes the columns), the window LENGTH is the
+    # DYNAMIC SimState.window_len operand — retune without recompile
+    # (Runtime.set_window_len). Installing harness.recovery_invariant
+    # deliberately pierces the transparency contract exactly like
+    # slo_invariant does for the latency plane (DESIGN §22).
+    series_windows: int = 0
+    # initial SimState.window_len in ticks per window (DYNAMIC knob,
+    # like slo_target/sketch_every; default 1 simulated second)
+    window_len: int = TICKS_PER_SEC
     # emission-write lowering: how staged emissions land in the event
     # table. "onehot" = [E, C] one-hot masked-sum (VPU-friendly — the TPU
     # default); "scatter" = one XLA scatter per column at distinct slot
@@ -407,6 +470,9 @@ class SimConfig:
         assert 0 <= self.latency_hist <= 32, \
             "latency_hist is a log2 BUCKET COUNT; 32 covers int32 ticks"
         assert self.slo_target >= 0
+        assert self.series_windows >= 0
+        assert self.window_len >= 1, \
+            "window_len is ticks per series window; must be >= 1"
         # normalize to a tuple of (kind, tag) int pairs (frozen dataclass:
         # go through object.__setattr__) so the signature/hash are stable
         # across list-vs-tuple spellings
@@ -451,12 +517,16 @@ class SimConfig:
         ride as operands. `emission_write` stays raw here — 'auto'
         resolves per backend at trace time, and the cache keys the
         backend separately."""
-        return ("simconfig-v6", self.n_nodes, self.event_capacity,
+        return ("simconfig-v7", self.n_nodes, self.event_capacity,
                 self.payload_words, self.table_dtype, self.emission_write,
                 bool(self.collect_stats), self.trace_cap_bucket,
                 self.sketch_slots, self.net.op_jitter_max > 0,
                 bool(self.profile),
-                self.latency_hist, self.complete_kinds, self.root_kinds)
+                self.latency_hist, self.complete_kinds, self.root_kinds,
+                # v7 (r21): the windowed-telemetry plane's window COUNT —
+                # appended at the END so the _SIG_WORLD_IDX world-slice
+                # indices (core/state.py) keep naming the same fields
+                self.series_windows)
 
     def hash(self) -> str:
         """Stable 8-hex-digit config hash, printed on test failure so a repro

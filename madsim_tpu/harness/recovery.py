@@ -1,0 +1,137 @@
+"""Recovery invariants: failing to HEAL as a first-class crash code.
+
+The windowed telemetry plane (cfg.series_windows, DESIGN §22) records
+WHEN things happened in sim time; this module ENFORCES a shape on that
+timeline: `recovery_invariant(p99_le=..., within=R)` builds a traced
+callable over the per-window series columns usable as
+`Runtime(invariant=)`, crashing a lane that keeps missing its
+steady-state envelope after the last disruptive fault window has had R
+windows to drain. An aggregate SLO can't express this — a run that
+degrades under partition and RECOVERS looks identical, in whole-run
+percentiles, to one that degrades and stays degraded. The recovery
+oracle separates them: transient pain inside the grace windows is
+tolerated; pain that persists past it is a bug with its own code
+(`CRASH_RECOVERY`), which the whole search/triage stack inherits for
+free — the fuzzer harvests (seed, knobs) repros, `harness.minimize`
+ddmin-shrinks the fault script, `service.CrashBuckets` dedups by
+causal fingerprint.
+
+The deliberate contract pierce (the `slo_invariant` precedent):
+installing a recovery invariant makes the series plane OBSERVABLE —
+crash_code now depends on sr_* for THAT runtime, so the plane joins
+its replay domain. Every runtime that doesn't install one keeps the
+plane transparent; tests hold both directions. Keep every lane's
+series recording ON (the init_batch default): a `series_lanes`-masked
+lane never accumulates windows, so its oracle can never fire.
+
+Windowing semantics the oracle leans on (core/step.py):
+  - a dispatch at post-advance `now` lands in window
+    min(now // max(window_len, 1), W-1);
+  - a window w is JUDGED only once complete ((w+1)·window_len <= now) —
+    a half-filled window's p99 over three samples is noise, not verdict;
+  - fault markers (sr_fault) are set ON DISPATCH of the disrupting
+    operation; only `SRF_DISRUPT` bits (kill/partition/net/gray/conn)
+    start the recovery clock — boots and heals are the cure, not the
+    disease.
+
+Determinism: per-window p99 is the all-integer bucket-CDF lower bound
+(the `slo_invariant` rule, one bucket→edge encoding via
+`bucket_lower_edge`), window completeness is integer arithmetic on
+`now`, and the fault word is an exact bitmask — the check is a pure
+function of the lane's dispatch history and fires on the SAME dispatch
+in every replay.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import types as T
+from ..parallel.stats import bucket_lower_edge
+
+
+def recovery_invariant(p99_le: int | None = None,
+                       qhw_le: int | None = None, *,
+                       within: int = 2, min_count: int = 1,
+                       code: int = T.CRASH_RECOVERY):
+    """Build a `Runtime(invariant=)` callable that crashes a lane which
+    fails to return to its steady-state envelope after faults stop.
+
+    Args:
+      p99_le: per-window end-to-end p99 estimate must be back at or
+        under this many ticks in every judged window (needs
+        cfg.latency_hist > 0 and complete_kinds — the sr_lat columns).
+      qhw_le: per-window queue high-water must be back at or under
+        this occupancy in every judged window (no latency plane
+        needed). Give either threshold or both.
+      within: grace windows after the LAST disruptive fault window;
+        judging starts at window last_fault + within (R in DESIGN
+        §22). A fault too close to the end of the W-window timeline
+        leaves nothing to judge — size series_windows so the tail of
+        the run keeps at least `within` + 1 windows past the last
+        planned fault.
+      min_count: a window's p99 is judged only once it folded at least
+        this many completions (per lane, per window) — an empty or
+        near-empty recovery window proves silence, not health; the
+        qhw_le check has no such guard (an empty window's high-water
+        is legitimately 0).
+      code: the crash code reported (default CRASH_RECOVERY).
+
+    A lane with NO disruptive fault window never fires — the oracle
+    judges recovery, not steady-state (install `slo_invariant` for
+    that). Windows that never completed (run ended mid-window, or
+    overflow-clamped tail traffic) are never judged. The p99 estimate
+    is the bucket-CDF LOWER bound: it can only under-read, so a firing
+    oracle means the true bucketed quantile genuinely exceeds the
+    threshold.
+
+    Requires cfg.series_windows > 0 (raises at trace time otherwise);
+    the p99_le form additionally requires the latency plane.
+    """
+    if p99_le is None and qhw_le is None:
+        raise ValueError("recovery_invariant needs p99_le= or qhw_le= "
+                         "(or both)")
+    if int(within) < 1:
+        raise ValueError("within must be >= 1 window of grace")
+    within_i = int(within)
+    min_count_i = int(min_count)
+
+    def check(state):
+        sf = state.sr_fault
+        W = sf.shape[-1]
+        if W == 0:
+            raise ValueError(
+                "recovery_invariant needs the windowed telemetry plane "
+                "compiled in — set SimConfig(series_windows=...) > 0")
+        if p99_le is not None and (state.sr_lat.shape[-2] == 0
+                                   or state.sr_lat.shape[-1] == 0):
+            raise ValueError(
+                "recovery_invariant(p99_le=) needs the latency plane — "
+                "set SimConfig(latency_hist=...) > 0 and declare "
+                "complete_kinds (use qhw_le= for a queue-only oracle)")
+        wl = jnp.maximum(state.window_len, 1)
+        widx = jnp.arange(W)
+        complete = (widx + 1) * wl <= state.now
+        fault_w = (sf & T.SRF_DISRUPT) != 0
+        has_fault = fault_w.any()
+        # last disruptive window: index of the final True (argmax of
+        # the reversed mask); garbage when has_fault is False, but the
+        # verdict is gated on has_fault so it never leaks
+        last = (W - 1) - jnp.argmax(fault_w[::-1]).astype(jnp.int32)
+        judged = complete & (widx >= last + within_i)
+        bad_w = jnp.zeros((W,), bool)
+        if qhw_le is not None:
+            bad_w = bad_w | (state.sr_qhw > int(qhw_le))
+        if p99_le is not None:
+            counts = state.sr_lat.astype(jnp.int32)       # [W, LB]
+            total = counts.sum(-1)                        # [W]
+            cdf = jnp.cumsum(counts, axis=-1)
+            # ceil(total*99/100) all-integer, >= 1 (slo.py rule)
+            need = jnp.maximum((total * 99 + 99) // 100, 1)[:, None]
+            b = jnp.argmax(cdf >= need, axis=-1).astype(jnp.int32)
+            edge = jnp.where(total > 0, bucket_lower_edge(b), 0)
+            bad_w = bad_w | ((total >= min_count_i) & (edge > int(p99_le)))
+        bad = state.sr_on & has_fault & (judged & bad_w).any()
+        return bad, jnp.asarray(code, jnp.int32)
+
+    return check

@@ -42,6 +42,17 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   the coverage/rate/p99 curves, attribution bars,
                   bucket lifecycle table with repro one-liners — no
                   server, no JS deps; pure read side of the store.
+  * series.py   — (r21) the WHEN layer: the windowed telemetry plane
+                  (cfg.series_windows, SimState sr_* columns) rendered
+                  as sim-time reports and TRUE sim-time Perfetto
+                  counter tracks — per-window dispatch/queue/drop/
+                  latency/fault series bucketed by virtual time, fed
+                  by the on-device `parallel.stats.series_digest`
+                  reduction (O(W·K) per sweep). Window timestamps
+                  never wrap: where the ring-derived r15/r16 counter
+                  tracks go silent past trace_cap, the series tracks
+                  cover t=0 to now, and `counter_track_events`
+                  prefers them when the plane is compiled in.
   * timetravel.py—(r20) the WHEN-AGAIN layer: lane checkpoints
                   harvested at existing chunk syncs
                   (`run(ckpt_every=K)` -> CheckpointLog), window
@@ -68,6 +79,8 @@ from .profiler import (counter_track_events, curve_brief,
                        profile_summary)
 from .progress import ProgressObserver
 from .rings import ring_records, sampled_lanes
+from .series import (fault_names, format_series, lane_series,
+                     series_counter_track_events, series_summary)
 from .trace import export_chrome_trace, to_chrome_events
 
 __all__ = [
@@ -79,6 +92,8 @@ __all__ = [
     "profile_summary", "format_profile", "counter_track_events",
     "export_profile_trace",
     "latency_summary", "format_latency", "latency_histogram_rows",
+    "series_summary", "format_series", "lane_series",
+    "series_counter_track_events", "fault_names",
     "render_html", "sparkline_svg", "curve_brief",
     "CheckpointLog", "replay_window", "full_chain_replay",
     "divergence_report", "ReplayDivergence",

@@ -197,6 +197,52 @@ def _tile(label: str, value, delta: str | None = None,
             f'<div class="value">{_esc(value)}</div>{d}{spark}</div>')
 
 
+def series_sparklines_html(summary: dict | None) -> str:
+    """Sim-time sparkline tiles off a `series_summary` dict (the r21
+    windowed telemetry plane, obs/series.py): dispatches, queue
+    high-water, and per-window e2e p99 on the VIRTUAL-time axis —
+    every other curve on this dashboard is wall-clock campaign
+    history; these are one run's own timeline, so a partition window
+    reads as a spike at its sim-time offset. The fault markers ride
+    as a decoded footnote (which windows saw disruptions/heals).
+    Empty string when there is nothing to render (plane compiled out
+    or no summary attached — the section simply doesn't appear)."""
+    if not summary or not summary.get("rows"):
+        return ""
+    rows = summary["rows"]
+    ts = [r["t0_us"] / 1e6 for r in rows]       # virtual seconds
+
+    def curve(key):
+        return [[ts[i], rows[i].get(key, 0)] for i in range(len(rows))]
+
+    tiles = [
+        _tile("Dispatches / window",
+              _fmt(max(r["dispatches"] for r in rows)),
+              curve=curve("dispatches")),
+        _tile("Queue high-water",
+              _fmt(max(r["qhw"] for r in rows)),
+              curve=curve("qhw")),
+    ]
+    if any("e2e_p99" in r for r in rows):
+        tiles.append(_tile(
+            "e2e p99 / window",
+            f"{_fmt(max(r.get('e2e_p99', 0) for r in rows))}us",
+            curve=curve("e2e_p99"), unit="us"))
+        tiles.append(_tile("SLO misses / window",
+                           _fmt(sum(r["slo_miss"] for r in rows)),
+                           curve=curve("slo_miss")))
+    marks = [f"w{r['window']} {'+'.join(r['faults'])}"
+             for r in rows if r["faults"]]
+    note = ("fault windows: " + " &middot; ".join(_esc(m) for m in marks)
+            if marks else "no fault markers")
+    return (
+        f"<h2>Sim-time telemetry &mdash; {_esc(summary['windows'])} "
+        f"windows &times; {_esc(summary['window_len'])}us of virtual "
+        f"time ({_esc(summary['lanes'])} recording lanes)</h2>"
+        f'<div class="tiles">{"".join(tiles)}</div>'
+        f'<p class="sub">{note}</p>')
+
+
 def attribution_bars_html(title: str, counts: dict,
                           order=None) -> str:
     """One attribution panel: a horizontal bar per class, single hue
@@ -377,6 +423,7 @@ def render_html(cur: dict, diff: dict | None = None,
 {attribution_bars_html("Buckets by operator",
                        attr.get("operator_buckets", {}))}
 </div>
+{series_sparklines_html(cur.get("series"))}
 <h2>Buckets — lifecycle, attribution, repro health</h2>
 {bucket_table_html(cur, diff)}
 <h2>Workers</h2>

@@ -248,17 +248,38 @@ def counter_track_events(state, lane: int = 0, node_names=None,
     already-unwrapped `recs` (a `ring_records` dict for this lane) to
     skip re-reading the ring — `export_profile_trace` does, halving
     its host transfer.
+
+    r21: when the windowed series plane is compiled in
+    (cfg.series_windows > 0) and this lane records, the queue_depth /
+    busy% / e2e_p99 tracks are DERIVED FROM THE SERIES instead
+    (obs/series.py) — true window-start timestamps covering the whole
+    run, where the ring reconstruction goes silent for everything
+    older than trace_cap dispatches after a wrap. The ring paths below
+    remain the fallback (finer grain: one point per dispatch, per-node
+    p99) when the plane is off or the lane is series-masked; the
+    cov_divergence track is ring/sketch-based either way.
     """
+    from .series import series_counter_track_events
+    out = series_counter_track_events(state, lane, node_names=node_names)
+    from_series = bool(out)
     if recs is None:
-        recs = ring_records(state, lane)
+        if from_series:
+            # series-only build: the ring may be compiled out entirely —
+            # the series tracks stand on their own (no cov_divergence /
+            # per-node rolling p99, which are ring/sketch-derived)
+            try:
+                recs = ring_records(state, lane)
+            except ValueError:
+                return out
+        else:
+            recs = ring_records(state, lane)
     n = len(recs["now"])
-    out = []
     qlen = recs.get("qlen")
-    if qlen is not None:
+    if qlen is not None and not from_series:
         out += [_counter("queue_depth", recs["now"][i], qlen[i], "depth")
                 for i in range(n)]
     # cumulative busy% per node over the ring window
-    if n:
+    if n and not from_series:
         t0 = int(recs["now"][0])
         nodes = sorted({int(x) for x in recs["node"]})
         label = {nd: (node_names[nd] if node_names is not None
@@ -276,7 +297,7 @@ def counter_track_events(state, lane: int = 0, node_names=None,
                                     "busy_pct"))
     # rolling per-node e2e p99 over the ring window's completions
     lat = recs.get("lat")
-    if lat is not None and n:
+    if lat is not None and n and not from_series:
         label = {}
         window: dict[int, list] = {}
         for i in range(n):

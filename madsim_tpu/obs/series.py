@@ -1,0 +1,222 @@
+"""Windowed-series reports + true sim-time Perfetto counter tracks.
+
+The WHEN layer of the observability stack (DESIGN §22): the r15/r16
+profiler answers *where* effort went and *how long* requests took —
+over the WHOLE run, one number per counter. This module renders the
+r21 windowed telemetry plane (cfg.series_windows, the sr_* SimState
+columns): the same pressure and tail signals bucketed by VIRTUAL TIME,
+so a partition at t=2s reads as a spike in windows 2-3 and a heal
+reads as the curve coming back down — the shape the recovery oracle
+(`harness.recovery_invariant`) judges and the fuzzer's burst_bonus
+hunts.
+
+Three consumers:
+
+  * `series_summary` / `format_series` — the operator report: batch-
+    merged per-window rows off the on-device
+    `parallel.stats.series_digest` reduction (O(W·K) host transfer),
+    with the fault-marker words decoded to names.
+  * `lane_series` — ONE lane's raw window columns as host numpy (per-
+    lane triage, dashboard sparklines): unlike the ring, this is the
+    whole run's timeline — windows never wrap, late events clamp into
+    the last window instead of evicting the first.
+  * `series_counter_track_events` — Perfetto counter tracks with
+    timestamps at true window starts (w · window_len). The ring-derived
+    tracks in obs/profiler.py go silent for everything older than
+    trace_cap dispatches; these cover t=0 to now at window granularity
+    regardless of run length, and `counter_track_events` prefers them
+    when the plane is compiled in (satellite: ring path stays as the
+    fine-grained fallback).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import types as T
+from ..parallel.stats import latency_bucket_edges, series_counters
+
+# fault-marker bit -> operator-facing name (core/types.py SRF_*)
+SRF_NAMES = ((T.SRF_KILL, "kill"), (T.SRF_BOOT, "boot"),
+             (T.SRF_PARTITION, "partition"), (T.SRF_HEAL, "heal"),
+             (T.SRF_NET, "net"), (T.SRF_GRAY, "gray"),
+             (T.SRF_CONN, "conn"))
+
+
+def fault_names(word: int) -> list[str]:
+    """Decode a sr_fault bitmask word into sorted marker names."""
+    return [nm for bit, nm in SRF_NAMES if int(word) & bit]
+
+
+def _window_p99(lat: np.ndarray) -> np.ndarray:
+    """Per-window p99 lower-bound edges (ticks) from a [W, LB] int
+    window-latency histogram — the host-side twin of the all-integer
+    CDF rule in `harness.recovery` / `parallel.stats` (same
+    `latency_bucket_edges` table, so reports and oracle agree)."""
+    counts = lat.astype(np.int64)
+    total = counts.sum(-1)                                # [W]
+    cdf = counts.cumsum(-1)
+    need = np.maximum((total * 99 + 99) // 100, 1)[:, None]
+    b = (cdf >= need).argmax(-1)
+    edges = latency_bucket_edges(lat.shape[1])
+    return np.where(total > 0, edges[b], 0)
+
+
+def lane_series(state, lane: int = 0) -> dict | None:
+    """One lane's windowed series as host numpy: the whole-run timeline
+    for per-lane triage and sparklines. None when the plane is compiled
+    out (cfg.series_windows == 0), the state is unbatched, or the lane
+    was masked out of recording (`init_batch(series_lanes=)`) — a
+    masked lane's windows are all-zero by construction, which would
+    render as a healthy flatline; None says "not recorded" instead.
+
+    Keys: windows, window_len (this lane's dynamic knob), now, touched
+    (windows with any sim-time coverage, overflow window included),
+    dispatch [W, N], busy [W, N], qhw/drop/dup/complete/slo_miss/fault
+    [W], and — latency-plane builds — lat [W, LB] plus the derived
+    e2e_p99 [W] lower-bound edges."""
+    sq = getattr(state, "sr_qhw", None)
+    if sq is None or sq.ndim != 2 or sq.shape[1] == 0:
+        return None
+    if not bool(np.asarray(state.sr_on)[lane]):
+        return None
+    W = int(sq.shape[1])
+    wl = max(int(np.asarray(state.window_len)[lane]), 1)
+    now = int(np.asarray(state.now)[lane])
+    out = dict(
+        windows=W, window_len=wl, now=now,
+        touched=min(now // wl, W - 1) + 1,
+        dispatch=np.asarray(state.sr_dispatch[lane]),
+        busy=np.asarray(state.sr_busy[lane]),
+        qhw=np.asarray(sq[lane]),
+        drop=np.asarray(state.sr_drop[lane]),
+        dup=np.asarray(state.sr_dup[lane]),
+        complete=np.asarray(state.sr_complete[lane]),
+        slo_miss=np.asarray(state.sr_slo_miss[lane]),
+        fault=np.asarray(state.sr_fault[lane]),
+    )
+    sl = state.sr_lat
+    if sl.ndim == 3 and sl.shape[1] > 0 and sl.shape[2] > 0:
+        lat = np.asarray(sl[lane])
+        out["lat"] = lat
+        out["e2e_p99"] = _window_p99(lat)
+    return out
+
+
+def series_summary(state) -> dict | None:
+    """The windowed-series report for a batched state: one row per
+    window off the on-device `parallel.stats.series_digest` reduction
+    (batch-merged over the recording lanes), fault words decoded.
+    None when the plane is compiled out or the state is unbatched.
+
+    Row fields: window, t0_us (window start at the dominant
+    window_len), dispatches, busy_us, qhw (deepest queue any recording
+    lane saw in that window), drops, dups, completions, slo_miss,
+    e2e_p99 (merged lower-bound estimate; latency builds only), and
+    faults (decoded marker names — which disruptions/heals DISPATCHED
+    in this window, batch-OR)."""
+    c = series_counters(state)
+    if c is None:
+        return None
+    disp = np.asarray(c["dispatch"], np.int64)            # [W, N]
+    busy = np.asarray(c["busy"], np.int64)
+    wl = max(c["window_len"], 1)
+    rows = []
+    for w in range(c["windows"]):
+        row = dict(window=w, t0_us=w * wl,
+                   dispatches=int(disp[w].sum()),
+                   busy_us=int(busy[w].sum()),
+                   qhw=int(c["qhw"][w]),
+                   drops=int(c["drop"][w]), dups=int(c["dup"][w]),
+                   completions=int(c["complete"][w]),
+                   slo_miss=int(c["slo_miss"][w]),
+                   faults=fault_names(c["fault"][w]))
+        if "e2e_p99_by_window" in c:
+            row["e2e_p99"] = int(c["e2e_p99_by_window"][w])
+        rows.append(row)
+    return dict(lanes=c["lanes"], windows=c["windows"],
+                window_len=c["window_len"], rows=rows)
+
+
+def format_series(summary: dict | None) -> str:
+    """Render a `series_summary` dict as a fixed-width text table —
+    the operator-facing sim-time timeline."""
+    if summary is None:
+        return "series plane compiled out (SimConfig.series_windows=0)"
+    has_lat = any("e2e_p99" in r for r in summary["rows"])
+    head = (f"{'win':>4} {'t0_us':>10} {'dispatch':>9} {'qhw':>5} "
+            f"{'drops':>6} {'dups':>5} {'compl':>6}")
+    if has_lat:
+        head += f" {'p99_us':>7} {'miss':>5}"
+    head += "  faults"
+    lines = [
+        f"recorded lanes: {summary['lanes']}  windows: "
+        f"{summary['windows']} x {summary['window_len']}us",
+        head,
+    ]
+    for r in summary["rows"]:
+        line = (f"{r['window']:>4} {r['t0_us']:>10} {r['dispatches']:>9} "
+                f"{r['qhw']:>5} {r['drops']:>6} {r['dups']:>5} "
+                f"{r['completions']:>6}")
+        if has_lat:
+            line += f" {r.get('e2e_p99', 0):>7} {r['slo_miss']:>5}"
+        line += "  " + (",".join(r["faults"]) if r["faults"] else "-")
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def _counter(name: str, ts: int, value, series: str = "value",
+             pid: int = 0) -> dict:
+    # same Chrome-trace counter shape as obs/profiler.py emits — kept
+    # local so the module import graph stays acyclic (profiler imports
+    # this module lazily for the satellite derivation)
+    return dict(name=name, ph="C", ts=int(ts), pid=pid,
+                args={series: float(value)})
+
+
+def series_counter_track_events(state, lane: int = 0,
+                                node_names=None) -> list[dict]:
+    """Perfetto counter-track events for one lane from its windowed
+    series — timestamps at TRUE window starts (w · window_len) on the
+    same virtual-time axis as the r7 instants, covering the whole run
+    regardless of trace_cap wrap:
+
+      queue_depth    per-window event-table occupancy high-water
+      busy_pct:<n>   node n's busy share of each window's span
+      e2e_p99        merged per-window p99 lower bound (latency-plane
+                     builds; cluster-wide — per-node tails stay on the
+                     ring-derived rolling track)
+      slo_miss       per-window SLO miss count (latency-plane builds)
+      fault          the window's raw SRF_* marker word (0 = quiet)
+
+    Returns [] when the plane is compiled out or the lane is masked —
+    the caller (obs/profiler.counter_track_events) falls back to the
+    ring-reconstructed tracks then."""
+    ls = lane_series(state, lane)
+    if ls is None:
+        return []
+    wl, now = ls["window_len"], ls["now"]
+    W = ls["windows"]
+    N = ls["dispatch"].shape[1]
+    label = [node_names[n] if node_names is not None else f"node{n}"
+             for n in range(N)]
+    out = []
+    for w in range(ls["touched"]):
+        ts = w * wl
+        # the last structural window absorbs everything past W·wl
+        # (the clamp rule), so its span stretches to `now`
+        span = max((now - ts) if w == W - 1 else wl, 1)
+        span = min(span, max(now - ts, 1))
+        out.append(_counter("queue_depth", ts, ls["qhw"][w], "depth"))
+        for n in range(N):
+            out.append(_counter(
+                f"busy_pct:{label[n]}", ts,
+                round(100.0 * int(ls["busy"][w, n]) / span, 2),
+                "busy_pct"))
+        if "e2e_p99" in ls:
+            out.append(_counter("e2e_p99", ts, ls["e2e_p99"][w],
+                                "p99_us"))
+            out.append(_counter("slo_miss", ts, ls["slo_miss"][w],
+                                "misses"))
+        out.append(_counter("fault", ts, ls["fault"][w], "srf_bits"))
+    return out

@@ -495,6 +495,167 @@ def latency_brief(state) -> dict | None:
                 completions=int(c["e2e_hist"].sum()))
 
 
+# series-plane fault-marker bits small enough that an 8-lane bit
+# decomposition covers them (core/types.py SRF_*: 7 bits today)
+_SRF_BITS = 8
+
+
+@jax.jit
+def _series_digest(sr_dispatch, sr_busy, sr_qhw, sr_drop, sr_dup,
+                   sr_complete, sr_slo_miss, sr_lat, sr_fault, sr_on,
+                   window_len):
+    """Device-side reduction of the windowed telemetry plane
+    (cfg.series_windows, DESIGN §22): per-WINDOW masked batch sums over
+    the recording lanes — the sim-time shape the counter tracks and
+    sparklines render — plus per-window p99 estimates off the merged
+    window latency histograms and an OR-fold of the fault-marker words.
+    O(W·K) crosses the host boundary, never the [B, W, ...] lanes; the
+    same ship-summaries discipline as `_profile_digest` /
+    `_latency_digest`, riding the shared `_masked_half_sums` plumbing."""
+    onf = sr_on
+    w = onf.astype(jnp.int32)
+    n = w.sum()
+    s64 = _masked_half_sums
+    out = dict(
+        lanes=n,
+        # dominant dynamic knob across the recording lanes (all lanes
+        # normally share it; `set_window_len` writes the full batch)
+        window_len=jnp.where(onf, window_len, 0).max(),
+        dispatch=s64(sr_dispatch, w[:, None, None]),      # [2, W, N]
+        busy=s64(sr_busy, w[:, None, None]),              # [2, W, N]
+        drop=s64(sr_drop, w[:, None]),                    # [2, W]
+        dup=s64(sr_dup, w[:, None]),                      # [2, W]
+        complete=s64(sr_complete, w[:, None]),            # [2, W]
+        slo_miss=s64(sr_slo_miss, w[:, None]),            # [2, W]
+        # high-water is a MAX fold, not a sum: deepest queue any
+        # recording lane saw inside each window
+        qhw=jnp.where(onf[:, None], sr_qhw, 0).max(0),    # [W]
+    )
+    # fault markers are bitmasks — OR over lanes via bit decomposition
+    # (no integer or-reduce needed; SRF_* fits in _SRF_BITS lanes)
+    bits = jnp.arange(_SRF_BITS)
+    present = (((sr_fault[:, :, None] >> bits) & 1) > 0) & onf[:, None, None]
+    out["fault"] = (present.any(0).astype(jnp.int32) << bits).sum(-1)
+    if sr_lat.shape[1] > 0 and sr_lat.shape[2] > 0:
+        wf = onf.astype(jnp.float32)
+        lat_f = (sr_lat.astype(jnp.float32)
+                 * wf[:, None, None]).sum(0)              # [W, LB]
+        out["lat"] = s64(sr_lat, w[:, None, None])        # [2, W, LB]
+        out["e2e_p99_by_window"] = _hist_quantiles(
+            lat_f, (0.99,))[..., 0]                       # [W]
+    return out
+
+
+def series_digest(state):
+    """Launch the device-side series reduction over a batched state;
+    returns DEVICE arrays (force lazily) or None when the plane is
+    compiled out (cfg.series_windows == 0) or the state is unbatched."""
+    sq = getattr(state, "sr_qhw", None)
+    if sq is None or sq.ndim != 2 or sq.shape[1] == 0:
+        return None
+    return _series_digest(state.sr_dispatch, state.sr_busy, state.sr_qhw,
+                          state.sr_drop, state.sr_dup, state.sr_complete,
+                          state.sr_slo_miss, state.sr_lat, state.sr_fault,
+                          state.sr_on, state.window_len)
+
+
+def series_counters(state) -> dict | None:
+    """Materialize `series_digest` host-side: exact per-window int64
+    series (the split 16-bit half-sums recombined), the batch-OR fault
+    words, and per-window p99 estimates in ticks. None when the plane
+    is compiled out. Run-twice confirmed + memoized
+    (`_confirmed_digest` — the same persistent-cache containment the
+    profiler and latency digests ride, r20)."""
+    sq = getattr(state, "sr_qhw", None)
+    if sq is None or sq.ndim != 2 or sq.shape[1] == 0:
+        return None
+    d = _confirmed_digest(
+        series_digest, state,
+        (state.sr_dispatch, state.sr_busy, state.sr_qhw, state.sr_drop,
+         state.sr_dup, state.sr_complete, state.sr_slo_miss, state.sr_lat,
+         state.sr_fault, state.sr_on, state.window_len))
+    if d is None:
+        return None
+
+    def wide(a):
+        a = a.astype(np.int64)
+        return a[0] * 65536 + a[1]
+
+    out = dict(
+        lanes=int(d["lanes"]),
+        windows=int(sq.shape[1]),
+        window_len=int(d["window_len"]),
+        dispatch=wide(d["dispatch"]),                     # int64 [W, N]
+        busy=wide(d["busy"]),                             # int64 [W, N]
+        drop=wide(d["drop"]).tolist(),
+        dup=wide(d["dup"]).tolist(),
+        complete=wide(d["complete"]).tolist(),
+        slo_miss=wide(d["slo_miss"]).tolist(),
+        qhw=d["qhw"].tolist(),
+        fault=d["fault"].tolist(),
+    )
+    if "lat" in d:
+        out["lat"] = wide(d["lat"])                       # int64 [W, LB]
+        out["e2e_p99_by_window"] = d["e2e_p99_by_window"].tolist()
+    return out
+
+
+@jax.jit
+def _lane_burst_lat(sr_lat):
+    """Per-lane deepest TRANSIENT p99: each lane's per-window e2e p99
+    estimate (windows kept separate — the whole point), max over
+    windows. int32[B] bucket lower edges."""
+    hist = sr_lat.astype(jnp.float32)                     # [B, W, LB]
+    return _hist_quantiles(hist, (0.99,))[..., 0].max(-1)
+
+
+@jax.jit
+def _lane_burst_qhw(sr_qhw):
+    return sr_qhw.max(-1)
+
+
+def lane_burst(state) -> np.ndarray | None:
+    """Host-side per-lane burst metric off the series plane: the
+    deepest per-WINDOW p99 spike a lane hit (falling back to the
+    per-window queue high-water when the latency plane is compiled
+    out). This is the transient signal `lane_e2e_p99` cannot see — an
+    aggregate p99 over the whole run dilutes a one-window spike that a
+    heal then papers over, which is exactly the trajectory shape the
+    recovery oracle and the fuzzer's burst_bonus hunt. None when the
+    series plane is compiled out. One [B] int32 transfer."""
+    sq = getattr(state, "sr_qhw", None)
+    if sq is None or sq.ndim != 2 or sq.shape[1] == 0:
+        return None
+    sl = state.sr_lat
+    if sl.ndim == 3 and sl.shape[1] > 0 and sl.shape[2] > 0:
+        return np.asarray(_lane_burst_lat(sl))
+    return np.asarray(_lane_burst_qhw(sq))
+
+
+def series_brief(state) -> dict | None:
+    """The small JSON-able series rollup `summarize()` carries: window
+    geometry, the peak window's dispatch volume and queue high-water,
+    the worst per-window p99, and which windows saw disruptive faults.
+    None when the plane is compiled out."""
+    c = series_counters(state)
+    if c is None:
+        return None
+    disp_w = c["dispatch"].sum(-1)                        # [W] totals
+    out = dict(lanes=c["lanes"], windows=c["windows"],
+               window_len=c["window_len"],
+               dispatch_peak=int(disp_w.max(initial=0)),
+               dispatch_peak_window=int(disp_w.argmax()) if len(disp_w)
+               else 0,
+               qhw_peak=int(max(c["qhw"], default=0)),
+               drops=int(sum(c["drop"])), dups=int(sum(c["dup"])),
+               fault_windows=[i for i, f in enumerate(c["fault"]) if f])
+    if "e2e_p99_by_window" in c:
+        p99w = c["e2e_p99_by_window"]
+        out["e2e_p99_peak"] = int(max(p99w, default=0))
+        out["slo_miss"] = int(sum(c["slo_miss"]))
+    return out
+
+
 def schedule_representatives(state, seeds) -> dict:
     """{sched_hash: first seed that produced it} — one replayable
     representative per distinct interleaving class. After a sweep, replay
@@ -591,6 +752,10 @@ def summarize(rt, state, seeds=None) -> dict:
         # misses off the latency plane — None when cfg.latency_hist
         # is 0.
         latency=latency_brief(state),
+        # WHEN inside the run it happened (r21): the windowed series
+        # rollup — peak window, transient p99 spike, fault windows.
+        # None when cfg.series_windows is 0.
+        series=series_brief(state),
         oops=int((np.asarray(state.oops) != 0).sum()),
     )
 

@@ -261,7 +261,8 @@ class Runtime:
         return mask
 
     def init_batch(self, seeds, trace_lanes=None,
-                   profile_lanes=None, latency_lanes=None) -> SimState:
+                   profile_lanes=None, latency_lanes=None,
+                   series_lanes=None) -> SimState:
         """Initial batched state for an array of seeds (replay-by-seed:
         the same seed always reproduces the same trajectory, the
         MADSIM_TEST_SEED contract of macros lib.rs:141-145).
@@ -287,6 +288,13 @@ class Runtime:
         campaign needs no warm-up. A runtime whose `invariant=` is
         harness.slo_invariant should keep every lane on: a masked lane
         never folds, so its SLO can never fire.
+
+        series_lanes: which lanes the windowed telemetry plane records
+        when cfg.series_windows > 0 (None = all; same forms; bench.py
+        --mode series_ab bounds the masked cost). A runtime whose
+        `invariant=` is harness.recovery_invariant should keep every
+        lane on — a masked lane's windows never fill, so its recovery
+        oracle can never fire (the slo_invariant rule).
         """
         seeds = jnp.atleast_1d(jnp.asarray(seeds, jnp.uint32))
         keys = jax.vmap(prng.seed_key)(seeds)
@@ -324,6 +332,15 @@ class Runtime:
             mask = self._lane_mask(latency_lanes, int(seeds.shape[0]),
                                    "latency_lanes")
             batched = batched.replace(lh_on=jnp.asarray(mask))
+        if series_lanes is not None:
+            if self.cfg.series_windows == 0:
+                raise ValueError(
+                    "series_lanes given but cfg.series_windows == 0 — the "
+                    "windowed telemetry plane is compiled out; set "
+                    "SimConfig(series_windows=...) > 0")
+            mask = self._lane_mask(series_lanes, int(seeds.shape[0]),
+                                   "series_lanes")
+            batched = batched.replace(sr_on=jnp.asarray(mask))
         return batched
 
     def init_single(self, seed: int) -> SimState:
@@ -840,6 +857,25 @@ class Runtime:
                 "plane is compiled out")
         return state.replace(
             slo_target=jnp.full_like(state.slo_target, int(target)))
+
+    def set_window_len(self, state: SimState, ticks: int) -> SimState:
+        """Retune every trajectory's series window length (virtual ticks
+        per window) — window_len is dynamic state like slo_target, so no
+        recompile (the r8 structural/dynamic discipline: the window
+        COUNT shapes the program, the window LENGTH rides as an
+        operand). Requires the windowed telemetry plane compiled in
+        (cfg.series_windows > 0). Retuning MID-RUN re-buckets only
+        future dispatches — already-folded windows keep their old
+        boundaries — so retune between sweeps, not inside one, unless
+        a mixed axis is what you want."""
+        if self.cfg.series_windows == 0:
+            raise ValueError(
+                "set_window_len needs cfg.series_windows > 0 — the "
+                "windowed telemetry plane is compiled out")
+        if int(ticks) < 1:
+            raise ValueError("window_len must be >= 1 tick")
+        return state.replace(
+            window_len=jnp.full_like(state.window_len, int(ticks)))
 
     # ------------------------------------------------------------------
     def fingerprints(self, state: SimState) -> np.ndarray:

@@ -27,6 +27,17 @@ setting needs):
     the on-device latency plane (SimState.lh_e2e, cfg.latency_hist);
     lat_bonus=0 (the default) keeps energy latency-blind and a build
     without the plane is always blind regardless.
+  - (r21, opt-in) lanes whose DEEPEST TRANSIENT SPIKE sits high get an
+    admission bonus scaled by how close to the round's worst spike
+    they are (up to x(1+burst_bonus)) — the lat_bonus treatment
+    applied to the WINDOWED series (SimState sr_*, cfg.series_windows):
+    the per-lane metric is the worst per-WINDOW p99 (queue high-water
+    on latency-less builds), so a mutant that digs one deep transient
+    hole which the aggregate p99 then averages away — exactly the
+    trajectory shape the recovery oracle judges — outscores a mutant
+    that is merely uniformly slow. Fed by `parallel.stats.lane_burst`;
+    burst_bonus=0 (the default) keeps energy burst-blind and a build
+    without the series plane is always blind regardless.
   - (r10) lanes that diverged from the campaign's consensus prefix EARLY
     get an admission bonus scaled by depth (up to x(1+div_bonus)),
     computed from the on-device prefix-coverage sketches
@@ -74,7 +85,7 @@ class Corpus:
                  fresh_frac: float = 0.125, decay: float = 0.97,
                  reward: float = 1.5, energy_cap: float = 8.0,
                  div_bonus: float = 1.0, lat_bonus: float = 0.0,
-                 worker_id: int = 0):
+                 burst_bonus: float = 0.0, worker_id: int = 0):
         self.plan = plan
         self.rng = rng if rng is not None else np.random.default_rng(0)
         self.max_entries = int(max_entries)
@@ -84,6 +95,7 @@ class Corpus:
         self.energy_cap = float(energy_cap)
         self.div_bonus = float(div_bonus)   # 0 = sched_hash-only energy
         self.lat_bonus = float(lat_bonus)   # 0 = latency-blind energy
+        self.burst_bonus = float(burst_bonus)  # 0 = burst-blind energy
         self.worker_id = int(worker_id)
         self.entries: list[dict] = []   # slot-stable: eviction replaces
         self._seen: set[int] = set()    # every hash ever admitted (dedupe)
@@ -221,7 +233,7 @@ class Corpus:
     # ------------------------------------------------------------------
     def observe(self, knobs_batch, seeds, hashes_u64, crashed, codes,
                 parent_ids, round_no: int, sketches=None,
-                last_op=None, lat_p99=None) -> dict:
+                last_op=None, lat_p99=None, burst=None) -> dict:
         """Fold one harvested round into the corpus. `knobs_batch` is the
         HOST knob batch that ran, `hashes_u64` the per-lane schedule
         hashes, `parent_ids` the corpus entry id each lane mutated from
@@ -232,7 +244,11 @@ class Corpus:
         (KnobPlan.mutate's third output; -1 = untouched), `lat_p99` the
         optional int[B] per-lane end-to-end p99 estimate
         (parallel.stats.lane_e2e_p99 — enables the opt-in tail-latency
-        admission bonus when self.lat_bonus > 0). Returns
+        admission bonus when self.lat_bonus > 0), `burst` the optional
+        int[B] per-lane deepest-transient-spike metric
+        (parallel.stats.lane_burst off the windowed series — enables
+        the opt-in burst admission bonus when self.burst_bonus > 0).
+        Returns
         admission stats; with `last_op` given they include `op_yield` —
         admissions attributed by operator (int64[N_MUT_OPS + 1], last
         slot = "base"), summing exactly to `new`: which operators'
@@ -265,6 +281,14 @@ class Corpus:
                 # tail-amplification bonus scale: each lane's p99
                 # relative to the round's worst tail, in [0, 1]
                 lat_rel = lp / lat_max
+        burst_rel = None
+        if burst is not None and self.burst_bonus > 0:
+            bp = np.asarray(burst, np.float64)
+            burst_max = float(bp.max()) if bp.size else 0.0
+            if burst_max > 0:
+                # burst-amplification bonus scale: each lane's deepest
+                # per-window spike relative to the round's worst, [0, 1]
+                burst_rel = bp / burst_max
         for e in self.entries:
             e["energy"] = max(0.05, e["energy"] * self.decay)
         for i in range(len(seeds)):
@@ -295,6 +319,12 @@ class Corpus:
                 # admission energy, linear in relative tail height —
                 # the divergence-bonus treatment for tail amplification
                 energy *= 1.0 + self.lat_bonus * float(lat_rel[i])
+            if burst_rel is not None:
+                # transient-spike bonus (r21): a lane whose deepest
+                # per-window spike sits at the round's worst gets up
+                # to x(1 + burst_bonus) admission energy — amplifies
+                # mutants by their worst MOMENT, not worst aggregate
+                energy *= 1.0 + self.burst_bonus * float(burst_rel[i])
             entry = dict(id=self._next_id, hash=h, seed=int(seeds[i]),
                          knobs=KnobPlan.lane(knobs_batch, i),
                          energy=min(self.energy_cap, energy),

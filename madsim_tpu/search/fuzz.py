@@ -81,6 +81,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
          havoc: int = 3, fresh_frac: float = 0.125, rng_seed: int = 0,
          observer=None, minimize: bool = False, corpus: Corpus | None = None,
          div_bonus: float | None = None, lat_bonus: float | None = None,
+         burst_bonus: float | None = None,
          corpus_dir: str | None = None,
          worker_id: int = 0, sync_every: int = 1,
          verify_resume: bool | None = None):
@@ -108,7 +109,15 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     whose lane's own e2e p99 sits at the round's worst tail get up to
     x(1+lat_bonus) energy, so the fuzzer hunts TAIL AMPLIFICATION; the
     default None/0.0 keeps energy latency-blind, same None-keeps-
-    corpus-setting contract as div_bonus).
+    corpus-setting contract as div_bonus), burst_bonus (OPT-IN
+    transient-spike admission bonus when the runtime compiles the
+    windowed series plane in, cfg.series_windows > 0 — admissions are
+    scored by each lane's DEEPEST per-window spike
+    (parallel.stats.lane_burst: worst per-window p99, or queue
+    high-water without the latency plane), so a mutant that digs one
+    deep transient hole outscores one that is merely uniformly slow —
+    the admission shape that feeds `recovery_invariant` campaigns;
+    same None-keeps-corpus-setting contract).
 
     Durable-campaign args (corpus_dir is the switch):
       corpus_dir   a service.CorpusStore directory (created on first
@@ -194,7 +203,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 plan, worker_id=worker_id, rng_seed=rng_seed,
                 fresh_frac=fresh_frac,
                 div_bonus=1.0 if div_bonus is None else div_bonus,
-                lat_bonus=0.0 if lat_bonus is None else lat_bonus)
+                lat_bonus=0.0 if lat_bonus is None else lat_bonus,
+                burst_bonus=0.0 if burst_bonus is None else burst_bonus)
         else:
             if corpus.worker_id != worker_id:
                 # a mismatched namespace would persist a worker state
@@ -219,15 +229,20 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         corpus = Corpus(plan, rng=np.random.default_rng(rng_seed),
                         fresh_frac=fresh_frac,
                         div_bonus=1.0 if div_bonus is None else div_bonus,
-                        lat_bonus=0.0 if lat_bonus is None else lat_bonus)
+                        lat_bonus=0.0 if lat_bonus is None else lat_bonus,
+                        burst_bonus=(0.0 if burst_bonus is None
+                                     else burst_bonus))
     else:
-        # an explicit div_bonus/lat_bonus must win over a passed-in
-        # corpus's setting — silently keeping the old value would skew
-        # any with-vs-without energy comparison run through these args
+        # an explicit div_bonus/lat_bonus/burst_bonus must win over a
+        # passed-in corpus's setting — silently keeping the old value
+        # would skew any with-vs-without energy comparison run through
+        # these args
         if div_bonus is not None:
             corpus.div_bonus = float(div_bonus)
         if lat_bonus is not None:
             corpus.lat_bonus = float(lat_bonus)
+        if burst_bonus is not None:
+            corpus.burst_bonus = float(burst_bonus)
     master = jax.random.PRNGKey(np.uint32(rng_seed ^ 0x5EED5EED))
 
     def launch(r):
@@ -276,12 +291,16 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                      if lat_p99 is not None
                      and (observer is not None or store is not None)
                      else None)
+        # transient-spike signal (r21): per-lane deepest per-window
+        # spike for corpus energy — None on builds without the series
+        # plane (one [B] transfer)
+        burst = stats.lane_burst(state)
         if hist is not None:
             op_hist[:] += np.asarray(hist)
         return (seeds, ids, knobs_host, hashes,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
                 hist is not None, np.asarray(last_op), sketches, state,
-                lat_p99, lat_brief)
+                lat_p99, lat_brief, burst)
 
     def verified(harvested):
         """The run-twice resume guard (verify_resume): re-dispatch the
@@ -293,11 +312,12 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         from ..utils.verify import agree_twice
 
         def key_of(h):
-            hashes, crashed, codes, sketches, lat_p99 = \
-                h[3], h[4], h[5], h[8], h[10]
+            hashes, crashed, codes, sketches, lat_p99, burst = \
+                h[3], h[4], h[5], h[8], h[10], h[12]
             return (hashes.tobytes(), crashed.tobytes(), codes.tobytes(),
                     None if sketches is None else sketches.tobytes(),
-                    None if lat_p99 is None else lat_p99.tobytes())
+                    None if lat_p99 is None else lat_p99.tobytes(),
+                    None if burst is None else burst.tobytes())
 
         def again(prev):
             seeds, ids, knobs_host = prev[0], prev[1], prev[2]
@@ -341,12 +361,13 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         harvested = harvest(pending)
         if r == verify_round:
             harvested = verified(harvested)
-        (seeds, ids, knobs_host, hashes, crashed, codes,
-         mutated, last_op, sketches, state, lat_p99, lat_brief) = harvested
+        (seeds, ids, knobs_host, hashes, crashed, codes, mutated,
+         last_op, sketches, state, lat_p99, lat_brief, burst) = harvested
         rounds += 1
         cstats = corpus.observe(knobs_host, seeds, hashes, crashed, codes,
                                 ids, r, sketches=sketches,
-                                last_op=last_op, lat_p99=lat_p99)
+                                last_op=last_op, lat_p99=lat_p99,
+                                burst=burst)
         yield_hist[:] += cstats["op_yield"]
         for i in np.nonzero(crashed)[0]:
             c = int(codes[i])

@@ -88,7 +88,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                  fresh_frac: float = 0.125, rng_seed: int = 0,
                  observer=None, minimize: bool = False,
                  div_bonus: float | None = None,
-                 lat_bonus: float | None = None, merge_every: int = 1,
+                 lat_bonus: float | None = None,
+                 burst_bonus: float | None = None, merge_every: int = 1,
                  corpus_dir: str | None = None, worker_id: int = 0,
                  sync_every: int = 1, verify_resume: bool | None = None):
     """Coverage-guided schedule fuzzing, sharded across a device mesh.
@@ -194,6 +195,7 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 fresh_frac=fresh_frac,
                 div_bonus=1.0 if div_bonus is None else div_bonus,
                 lat_bonus=0.0 if lat_bonus is None else lat_bonus,
+                burst_bonus=0.0 if burst_bonus is None else burst_bonus,
                 state=(shard_states[s] if shard_states else None))
             c.track_admissions = True
             corpora.append(c)
@@ -207,7 +209,9 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             c = Corpus(plan, rng=np.random.default_rng(rng_seed + s),
                        fresh_frac=fresh_frac, worker_id=eff_w[s],
                        div_bonus=1.0 if div_bonus is None else div_bonus,
-                       lat_bonus=0.0 if lat_bonus is None else lat_bonus)
+                       lat_bonus=0.0 if lat_bonus is None else lat_bonus,
+                       burst_bonus=(0.0 if burst_bonus is None
+                                    else burst_bonus))
             c.track_admissions = True
             corpora.append(c)
     if div_bonus is not None:
@@ -216,6 +220,9 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
     if lat_bonus is not None:
         for c in corpora:
             c.lat_bonus = float(lat_bonus)
+    if burst_bonus is not None:
+        for c in corpora:
+            c.burst_bonus = float(burst_bonus)
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     lane_sharding = NamedSharding(mesh, P(SEED_AXIS))
@@ -307,12 +314,14 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                      if lat_p99 is not None
                      and (observer is not None or stores is not None)
                      else None)
+        # transient-spike signal (r21) — fuzz()'s harvest shape
+        burst = stats.lane_burst(state)
         if hist is not None:
             op_hist[:] += np.asarray(hist)
         return (seeds, ids, knobs_host, hashes, digest,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
                 mutated, np.asarray(last_op), sketches, state,
-                lat_p99, lat_brief)
+                lat_p99, lat_brief, burst)
 
     def do_merge():
         """The cross-shard exchange: admissions since the last merge
@@ -395,8 +404,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         if r == verify_round:
             harvested = _verified_harvest(
                 rt, plan, harvested, harvest, max_steps, chunk, fused, mesh)
-        (seeds, ids, knobs_host, hashes, digest, crashed, codes,
-         mutated, last_op, sketches, state, lat_p99, lat_brief) = harvested
+        (seeds, ids, knobs_host, hashes, digest, crashed, codes, mutated,
+         last_op, sketches, state, lat_p99, lat_brief, burst) = harvested
         rounds += 1
         corpus_size = 0
         per_shard_rows = []
@@ -409,7 +418,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 {k: v[lo:hi] for k, v in knobs_host.items()},
                 seeds[lo:hi], hashes[lo:hi], crashed[lo:hi], codes[lo:hi],
                 ids[lo:hi], r, sketches=sk_s, last_op=last_op[lo:hi],
-                lat_p99=(lat_p99[lo:hi] if lat_p99 is not None else None))
+                lat_p99=(lat_p99[lo:hi] if lat_p99 is not None else None),
+                burst=(burst[lo:hi] if burst is not None else None))
             round_yield += cstats["op_yield"]
             shard_seen[s] |= set(hashes[lo:hi].tolist())
             corpus_size += cstats["size"]
@@ -573,11 +583,12 @@ def _verified_harvest(rt, plan, harvested, harvest_fn, max_steps, chunk,
     from ..utils.verify import agree_twice
 
     def key_of(h):
-        hashes, crashed, codes, sketches, lat_p99 = \
-            h[3], h[5], h[6], h[9], h[11]
+        hashes, crashed, codes, sketches, lat_p99, burst = \
+            h[3], h[5], h[6], h[9], h[11], h[13]
         return (hashes.tobytes(), crashed.tobytes(), codes.tobytes(),
                 None if sketches is None else sketches.tobytes(),
-                None if lat_p99 is None else lat_p99.tobytes())
+                None if lat_p99 is None else lat_p99.tobytes(),
+                None if burst is None else burst.tobytes())
 
     def again(prev):
         # prev is a HARVESTED tuple: (seeds, ids, knobs_host, hashes,

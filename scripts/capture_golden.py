@@ -10,6 +10,9 @@ it gates against:
     # r19 contract (captured at r18 HEAD, before the connection-fault plane)
     JAX_PLATFORMS=cpu python scripts/capture_golden.py _connfault_golden
 
+    # r21 contract (captured at r20 HEAD, before the windowed-telemetry plane)
+    JAX_PLATFORMS=cpu python scripts/capture_golden.py _series_golden
+
 Re-running a capture after the gated engine change landed would
 overwrite the evidence with whatever the current tree produces — the
 test would then prove nothing.

@@ -81,6 +81,15 @@ case "$tier" in
     # CRASH_SLO and replay by seed, and the Perfetto export must carry
     # the rolling per-node e2e-p99 track
     python bench.py --lat-smoke
+    # windowed-telemetry smoke: every lane's device series must equal a
+    # host replay of the flight-recorder ring bucketed by the window
+    # rule, the plane on/masked/compiled-out must be bit-identical, the
+    # recovery oracle must stay green on the healed flagship and crash
+    # CRASH_RECOVERY deterministically (seed-replayable) on the
+    # unhealed one, the Perfetto export must carry true sim-time
+    # counter tracks, and a burst-guided fuzz campaign must open a
+    # CRASH_RECOVERY bucket whose (seed, knobs) handle replays red
+    python bench.py --series-smoke
     # gray-failure smoke: a one-way cut must be observed asymmetrically
     # by gossip, skewed lease expiry on the Percolator-lite flagship
     # must crash the snapshot oracle and reproduce on seed replay, and

@@ -8,10 +8,11 @@ buckets + worker state) plus a REGRESSION.json sidecar naming the
 runtime factory and replay budget. Re-run this ONLY when the store
 signature legitimately moves (a new knob dimension, a structural change
 to a flagship) — the whole point of the gate is that buckets keep
-reproducing across unrelated changes. (Last re-frozen at r19: the
-simconfig-v6 / knob-schema bump rejects pre-r19 corpus dirs with
-StoreMismatch, so both campaigns were regenerated; the grayfail
-trajectories themselves are bit-identical to the r17 freeze.)
+reproducing across unrelated changes. (Last re-frozen at r21: the
+simconfig-v7 bump — the windowed-telemetry plane's structural window
+count — rejects pre-r21 corpus dirs with StoreMismatch, so both
+campaigns were regenerated; the trajectories themselves are
+bit-identical to the r19 freeze, per the golden-equivalence gates.)
 
     JAX_PLATFORMS=cpu python scripts/make_regression_corpus.py [name ...]
 """

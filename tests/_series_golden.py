@@ -1,0 +1,45 @@
+"""Shared harness for the r21 bit-identical-when-disabled contract.
+
+The windowed telemetry plane (r21) added engine machinery — per-window
+dispatch/queue/busy/latency series leaves, the dynamic `window_len`
+operand, the recovery oracle — that is compiled out at the default
+`series_windows=0` and masked to identity when compiled in but no lane
+records. The contract is that a workload never enabling the plane
+produces trajectories BIT-IDENTICAL to r20, leaf for leaf, chunked and
+fused.
+
+Same frozen workload builders as the r17/r19 harnesses
+(_grayfail_golden — the canonical engine-equivalence workloads); digests
+were captured AT r20 HEAD by scripts/capture_golden.py into
+tests/data/golden_r20_leaves.json, before any r21 engine change landed.
+Every r20 leaf must still exist and hash identically — the only new
+leaves the r21 plane may add are the series plane's own
+(`.window_len`, `.sr_on`, and the zero-size `sr_*` columns the
+simconfig-v7 signature gates).
+"""
+
+from __future__ import annotations
+
+import os
+
+import _grayfail_golden as _g
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "data",
+                           "golden_r20_leaves.json")
+
+# the frozen definition is shared with the r17/r19 harnesses — one set
+# of engine workloads, three captured truths (r16, r18, r20)
+RUNS = _g.RUNS
+BUILDERS = _g.BUILDERS
+leaf_digests = _g.leaf_digests
+run_workload = _g.run_workload
+
+
+def capture(path: str = GOLDEN_PATH) -> dict:
+    return _g.capture(path)
+
+
+def load_golden(path: str = GOLDEN_PATH) -> dict:
+    with open(path) as f:
+        import json
+        return json.load(f)

@@ -44,9 +44,13 @@ class TestEquivalenceR16:
         # name: r17's gray-failure plane (skew/disk_lat/torn, gated by
         # simconfig-v5), r18's hash_base (the frozen seed key — a
         # constant that consumes nothing, which is exactly why every
-        # OTHER leaf must still match r16 bit for bit), and r19's
+        # OTHER leaf must still match r16 bit for bit), r19's
         # dup_rate (connection-fault plane, simconfig-v6 — its own
-        # golden gate lives in tests/test_connfault.py vs r18 truth).
+        # golden gate lives in tests/test_connfault.py vs r18 truth),
+        # and r21's windowed-telemetry plane (sr_*/window_len,
+        # simconfig-v7 — zero-size columns here since series_windows=0;
+        # its own golden gate lives in tests/test_series.py vs r20
+        # truth).
         gold = golden.load_golden()[workload]
         got = golden.run_workload(workload)
         for runner in ("run", "run_fused"):
@@ -57,7 +61,11 @@ class TestEquivalenceR16:
             assert not diff, (runner, diff)
             new = set(got[runner]) - set(gold[runner])
             assert new == {".skew", ".disk_lat", ".torn",
-                           ".hash_base", ".dup_rate"}, new
+                           ".hash_base", ".dup_rate",
+                           ".sr_on", ".window_len", ".sr_dispatch",
+                           ".sr_busy", ".sr_qhw", ".sr_drop", ".sr_dup",
+                           ".sr_complete", ".sr_slo_miss", ".sr_lat",
+                           ".sr_fault"}, new
 
 
 # ---------------------------------------------------------------------------
@@ -481,7 +489,7 @@ class TestCheckpointMigration:
 
     def test_signature_is_current(self):
         # r17 introduced v5; the r19 connection-fault plane bumped it to
-        # v6 (dup_rate leaf + conn-fault knob rows) — test_connfault.py
-        # owns the authoritative version assertion
+        # v6, and the r21 windowed-telemetry plane to v7 —
+        # test_series.py owns the authoritative version assertion
         cfg = SimConfig(n_nodes=2)
-        assert cfg.structural_signature()[0] == "simconfig-v6"
+        assert cfg.structural_signature()[0] == "simconfig-v7"
