@@ -1244,6 +1244,280 @@ def _search_smoke_mode():
         "wall_s": round(time.perf_counter() - t0, 1)}))
 
 
+def _ldfi_smoke_mode():
+    """--ldfi-smoke: seconds-scale lineage-driven-fault-injection
+    self-test for CI (wired into scripts/ci.sh fast):
+
+      1. support extraction on a seeded rpc_echo lane must match an
+         INLINE host-side parent-walk reference (independent code path
+         over the same ring records);
+      2. every synthesized targeted vector must stay on the knob plane:
+         rows re-aimed only where the time guard allows, targets pool-
+         confined (or NODE_RANDOM), values inside the row's [lo, hi];
+      3. one targeted round must replay bit-identically from its
+         (seed, knobs) handle — two independent apply+run dispatches,
+         identical fingerprints and crash verdicts.
+
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import numpy as np
+    from madsim_tpu import SimConfig, sec, ms
+    from madsim_tpu.core.types import EV_MSG, EV_TIMER, NODE_RANDOM
+    from madsim_tpu.models.rpc_echo import make_echo_runtime
+    from madsim_tpu.obs import ring_records
+    from madsim_tpu.obs.support import (extract_support,
+                                        support_from_records)
+    from madsim_tpu.runtime import chaos
+    from madsim_tpu.runtime.scenario import Scenario
+    from madsim_tpu.search import LdfiConfig, fuzz
+    from madsim_tpu.search.ldfi import SupportPool, synthesize
+    from madsim_tpu.search.mutate import KnobPlan
+    t0 = time.perf_counter()
+
+    sc = Scenario()
+    sc = chaos.asymmetric_partition(ms(400), [1], ms(300), sc=sc)
+    sc = chaos.conn_reset_storm(rounds=2, first=ms(300), period=ms(450),
+                                node=2, sc=sc)
+    sc = chaos.clock_drift(ms(200), 128, node=1, until=ms(900), sc=sc)
+    sc = chaos.retransmit_storm(ms(250), 0.3, ms(800), node=1, sc=sc)
+    cfg = SimConfig(n_nodes=4, event_capacity=256, time_limit=sec(20),
+                    trace_cap=64)
+    rt = make_echo_runtime(n_nodes=4, target=4, cfg=cfg, scenario=sc)
+    state, _ = rt.run(rt.init_batch(np.arange(8, dtype=np.uint32)),
+                      4000, 256)
+    assert not np.asarray(state.crashed)[0], "smoke lane went red"
+
+    # 1. extraction vs an inline parent-walk reference
+    sup = extract_support(state, 0)
+    assert sup is not None and not sup["truncated"]
+    recs = ring_records(state, 0)
+    by_step = {int(s): i for i, s in enumerate(recs["step"])}
+    i = len(recs["step"]) - 1            # default witness: last dispatch
+    ref_msgs, ref_timers = [], []
+    while True:
+        kind = int(recs["kind"][i])
+        if kind == EV_MSG:
+            ref_msgs.append((int(recs["src"][i]), int(recs["node"][i]),
+                             int(recs["now"][i])))
+        elif kind == EV_TIMER:
+            ref_timers.append((int(recs["node"][i]),
+                               int(recs["now"][i])))
+        parent = int(recs["parent"][i])
+        if parent < 0 or parent not in by_step:
+            break
+        i = by_step[parent]
+    ref_msgs.reverse()
+    ref_timers.reverse()
+    assert sup["msg_edges"] == ref_msgs, (sup["msg_edges"], ref_msgs)
+    assert sup["timer_edges"] == ref_timers
+    ref2 = support_from_records(recs)
+    assert ref2["msg_edges"] == ref_msgs and ref2["depth"] == sup["depth"]
+
+    # 2. synthesized rows stay on the knob plane
+    plan = KnobPlan.from_runtime(rt, dup_slots=2)
+    pool = SupportPool()
+    for lane in range(4):
+        s = extract_support(state, lane)
+        if s is not None:
+            pool.add(s)
+    assert len(pool) >= 2, "too few green supports pooled"
+    vecs = synthesize(plan, pool, 8)
+    assert vecs, "plan with 4 fault families synthesized nothing"
+    base = plan.base_knobs()
+    n_aimed = 0
+    for kn in vecs:
+        changed = [r for r in range(plan.R)
+                   if (kn["row_time"][r] != base["row_time"][r]
+                       or kn["row_node"][r] != base["row_node"][r]
+                       or kn["row_val"][r] != base["row_val"][r]
+                       or kn["row_flag"][r] != base["row_flag"][r]
+                       or kn["row_on"][r] != base["row_on"][r])]
+        assert changed, "synthesized vector with zero cuts escaped"
+        n_aimed += len(changed)
+        for r in changed:
+            assert plan.time_ok[r], f"row {r} re-aimed past its guard"
+            node = int(kn["row_node"][r])
+            assert node == NODE_RANDOM or (
+                0 <= node < plan.N and plan.pool_ok[r, node + 1]), \
+                f"row {r} target {node} escaped its pool"
+            v = int(kn["row_val"][r])
+            assert plan.val_lo[r] <= v <= plan.val_hi[r], \
+                (r, v, plan.val_lo[r], plan.val_hi[r])
+
+    # 3. a targeted round replays bit-identically from (seed, knobs)
+    seed, kn = 5, vecs[0]
+    runs = []
+    for _ in range(2):
+        st = plan.apply(
+            rt.init_batch(np.asarray([seed], np.uint32)),
+            KnobPlan.stack([kn]))
+        fin = rt.run_fused(st, 4000, 256)
+        runs.append((int(rt.fingerprints(fin)[0]),
+                     bool(np.asarray(fin.crashed)[0]),
+                     int(np.asarray(fin.crash_code)[0])))
+    assert runs[0] == runs[1], runs
+
+    # and the integrated arm runs end-to-end with honest accounting
+    res = fuzz(rt, max_steps=4000, batch=16, max_rounds=3, dry_rounds=4,
+               chunk=256, ldfi=LdfiConfig(lanes=4, frac=0.25))
+    assert res["targeted"]["supports"] >= 1
+    assert res["targeted"]["lanes_run"] >= 1
+    print(json.dumps({
+        "metric": "ldfi_smoke", "platform": "cpu", "ok": True,
+        "support_depth": sup["depth"],
+        "pooled_supports": len(pool),
+        "synthesized_vectors": len(vecs), "rows_aimed": n_aimed,
+        "targeted_lanes_run": res["targeted"]["lanes_run"],
+        "targeted_admitted": res["targeted"]["admitted"],
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
+def _make_aimed_asym_runtime():
+    """The ldfi_ab 'aimed' regime: Percolator-lite with the asym cut's
+    rows compiled in but parked at t=6s — AFTER the workload quiesces,
+    so the base scenario is GREEN and the fault rows are raw material.
+    Blind havoc must drift the cut (and its heal) into the right
+    ~100ms commit window by luck; the lineage arm re-aims them at
+    extracted support edges (and pins the seed whose timing it
+    learned). The regime where 'aim, don't spray' is the whole game."""
+    from madsim_tpu import NetConfig, Scenario, SimConfig, ms, sec
+    from madsim_tpu.models.percolator import make_percolator_runtime
+    from madsim_tpu.runtime import chaos
+    sc = Scenario()
+    sc = chaos.asymmetric_partition(ms(6000), [1], ms(300), direction=1,
+                                    sc=sc)
+    cfg = SimConfig(n_nodes=5, event_capacity=256, payload_words=8,
+                    time_limit=sec(10), trace_cap=128,
+                    net=NetConfig(send_latency_min=ms(1),
+                                  send_latency_max=ms(8)))
+    return make_percolator_runtime(n_clients=3, n_ops=12,
+                                   sync_commits=True, scenario=sc,
+                                   cfg=cfg)
+
+
+def _ldfi_ab_mode():
+    """--mode ldfi_ab: targeted (lineage-synthesized) vs blind
+    (fault_perturb havoc) fault search at EQUAL budget (same rounds x
+    batch x max_steps), in three fault regimes:
+
+      grayfail   Percolator-lite under the composed gray-failure mix
+      connfault  minipg (guards off) under the reset+dup storm mix
+      aimed      Percolator-lite, GREEN base, asym cut rows parked
+                 past quiesce (_make_aimed_asym_runtime)
+
+    The headline is SCHEDULES-TO-FIRST-BUCKET: how many schedules each
+    arm burned before its first causal-fingerprint crash bucket opened
+    ((first bucket's round + 1) x batch — lanes in one round are
+    concurrent, so the round that found it charges its whole batch).
+    Both arms run durable campaigns (throwaway corpus dirs) so buckets
+    dedup identically; the targeted arm additionally reports its
+    admission yield and bucket origins. An honest null result (targeted
+    not faster) is recorded in the regime's note rather than hidden.
+    Writes BENCH_ldfi_ab_<platform>.json. CPU-forced: the comparison is
+    about search QUALITY per schedule, not device throughput."""
+    _force_cpu_inprocess()
+    import shutil
+    import tempfile
+    from madsim_tpu.search import LdfiConfig, fuzz
+    from madsim_tpu.service.store import CorpusStore
+    platform = "cpu"
+    out = {"metric": "ldfi_ab", "platform": platform,
+           "note": ("equal budget = same rounds x batch x max_steps per "
+                    "arm; schedules_to_first_bucket = (first bucket's "
+                    "round + 1) x batch, None when an arm opened no "
+                    "bucket. The targeted arm spends ldfi.frac of each "
+                    "post-bootstrap round on lineage-synthesized "
+                    "vectors; everything else stays havoc"),
+           "regimes": {}}
+
+    def arm(make, rounds, batch, steps, chunk, ldfi):
+        tmp = tempfile.mkdtemp(prefix="ldfi_ab_")
+        try:
+            rt = make()
+            t0 = time.perf_counter()
+            res = fuzz(rt, max_steps=steps, batch=batch,
+                       max_rounds=rounds, dry_rounds=rounds + 1,
+                       chunk=chunk, corpus_dir=tmp, ldfi=ldfi)
+            dt = time.perf_counter() - t0
+            store = CorpusStore(tmp, create=False)
+            bucket_rounds = []
+            origins = {}
+            for key in store.bucket_keys():
+                rec = store.load_bucket(key)
+                bucket_rounds.append(int(rec["repro"]["round"]))
+                o = rec.get("origin", "havoc")
+                origins[o] = origins.get(o, 0) + 1
+            first = ((min(bucket_rounds) + 1) * batch
+                     if bucket_rounds else None)
+            row = {
+                "schedules_to_first_bucket": first,
+                "buckets": len(bucket_rounds),
+                "distinct_schedules": res["distinct_schedules"],
+                "crashes": res["crashes"],
+                "wall_s": round(dt, 2)}
+            if ldfi is not None:
+                row["targeted"] = res["targeted"]
+                row["bucket_origins"] = origins
+            return row
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def ab(name, make, rounds, batch, steps, chunk, ldfi=None):
+        # warm both arms' executables outside the timed region
+        fuzz(make(), max_steps=steps, batch=batch, max_rounds=2,
+             dry_rounds=3, chunk=chunk)
+        row = {"rounds": rounds, "batch": batch, "max_steps": steps}
+        row["blind"] = arm(make, rounds, batch, steps, chunk, None)
+        row["targeted"] = arm(
+            make, rounds, batch, steps, chunk,
+            ldfi or LdfiConfig(frac=0.25, lanes=8, max_cuts=2))
+        fb, ft = (row["blind"]["schedules_to_first_bucket"],
+                  row["targeted"]["schedules_to_first_bucket"])
+        if ft is not None and (fb is None or ft < fb):
+            row["verdict"] = "targeted_first"
+        elif ft == fb:
+            row["verdict"] = ("both_null" if ft is None else "tie")
+            row["note"] = ("honest null result: targeted did not reach "
+                           "a bucket in fewer schedules at this budget")
+            t_orig = row["targeted"].get("bucket_origins", {}).get(
+                "targeted", 0)
+            if ft is not None and (t_orig
+                                   or row["targeted"]["buckets"]
+                                   > row["blind"]["buckets"]):
+                row["note"] += (
+                    f" — but the targeted arm opened "
+                    f"{row['targeted']['buckets']} distinct buckets vs "
+                    f"blind's {row['blind']['buckets']}, {t_orig} of "
+                    f"them from targeted-origin lanes")
+        else:
+            row["verdict"] = "blind_first"
+            row["note"] = ("honest null result: blind reached its first "
+                           "bucket in fewer schedules at this budget")
+        out["regimes"][name] = row
+        print(f"--ldfi-ab: {name} first-bucket blind={fb} "
+              f"targeted={ft} ({row['verdict']})", file=sys.stderr)
+
+    ab("grayfail", functools.partial(_make_grayfail_runtime, "mix"),
+       rounds=4, batch=96, steps=20_000, chunk=512)
+    ab("connfault", functools.partial(_make_connfault_runtime, "mix"),
+       rounds=4, batch=96, steps=24_000, chunk=512)
+    # green-base regime: the fault rows start parked past quiesce, so
+    # every crash is a MUTATED fault — replay-upgraded supports, a
+    # bigger targeted slice, and more rounds at finer batch resolution
+    ab("aimed", _make_aimed_asym_runtime,
+       rounds=8, batch=24, steps=20_000, chunk=512,
+       ldfi=LdfiConfig(frac=0.5, lanes=6, max_cuts=2, replay=True))
+    out["targeted_first_somewhere"] = any(
+        r.get("verdict") == "targeted_first"
+        for r in out["regimes"].values())
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_ldfi_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
 def _grayfail_smoke_mode():
     """--grayfail-smoke: seconds-scale gray-failure-plane self-test for
     CI (scripts/ci.sh fast):
@@ -3671,7 +3945,7 @@ def main():
                  "--lat-ab", "--lat-smoke", "--series-ab",
                  "--series-smoke", "--grayfail-smoke",
                  "--regression-smoke", "--triage-smoke", "--conn-smoke",
-                 "--tt-ab", "--tt-smoke"}
+                 "--tt-ab", "--tt-smoke", "--ldfi-ab", "--ldfi-smoke"}
         if flag not in known:
             sys.exit(f"unknown mode {sys.argv[i + 1]!r} "
                      f"(known: {sorted(m[2:] for m in known)})")
@@ -3684,6 +3958,12 @@ def main():
         return
     if "--analyze-smoke" in sys.argv:
         _analyze_smoke_mode()
+        return
+    if "--ldfi-smoke" in sys.argv:
+        _ldfi_smoke_mode()
+        return
+    if "--ldfi-ab" in sys.argv:
+        _ldfi_ab_mode()
         return
     if "--grayfail-smoke" in sys.argv:
         _grayfail_smoke_mode()

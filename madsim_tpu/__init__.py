@@ -62,8 +62,10 @@ from .parallel.stats import (divergence_profile, schedule_representatives,
                              summarize)
 from .runtime.runtime import Runtime
 from .runtime.scenario import Scenario
-from .search import (Corpus, KnobPlan, fuzz, fuzz_sharded, pct_sweep,
-                     with_prio_nudge)
+from .harness.witness import success_witness
+from .obs.support import extract_support, support_from_records
+from .search import (Corpus, KnobPlan, LdfiConfig, fuzz, fuzz_sharded,
+                     pct_sweep, with_prio_nudge)
 from .service import (CorpusStore, audit_buckets, campaign_report,
                       merged_buckets, replay_bucket, run_campaign,
                       supervise_campaign, triage_diff, triage_snapshot)
@@ -80,6 +82,8 @@ __all__ = [
     "find_divergence",
     "fuzz", "fuzz_sharded", "Corpus", "KnobPlan", "pct_sweep",
     "with_prio_nudge",
+    "LdfiConfig", "success_witness", "support_from_records",
+    "extract_support",
     "SweepObserver", "JsonlObserver", "ProgressObserver", "ring_records",
     "export_chrome_trace", "explain_crash", "divergence_profile",
     "profile_summary", "format_profile", "export_profile_trace",

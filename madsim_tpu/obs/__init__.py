@@ -64,10 +64,17 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   microscope (`divergence_report` names two lanes'
                   first divergent dispatch by replaying from their
                   last common checkpoint under full tracing).
+  * support.py  — (r22) the WHY-IT-WORKED layer: walk the same lineage
+                  columns BACKWARD from a success witness in a GREEN
+                  lane to the support of its success — the message and
+                  timer edges the outcome causally depended on — the
+                  extraction half of lineage-driven fault targeting
+                  (search/ldfi.py synthesizes cuts against it).
 """
 
 from .causal import (causal_fingerprint, code_fingerprint, explain_crash,
-                     fingerprints_match, happens_before, sketch_divergence)
+                     fingerprints_match, happens_before, sketch_divergence,
+                     walk_lineage)
 from .dashboard import render_html, sparkline_svg
 from .metrics import JsonlObserver, SweepObserver, TeeObserver
 from .timetravel import (CheckpointLog, ReplayDivergence, divergence_report,
@@ -81,6 +88,7 @@ from .progress import ProgressObserver
 from .rings import ring_records, sampled_lanes
 from .series import (fault_names, format_series, lane_series,
                      series_counter_track_events, series_summary)
+from .support import extract_support, support_from_records
 from .trace import export_chrome_trace, to_chrome_events
 
 __all__ = [
@@ -89,6 +97,7 @@ __all__ = [
     "export_chrome_trace",
     "explain_crash", "happens_before", "sketch_divergence",
     "causal_fingerprint", "code_fingerprint", "fingerprints_match",
+    "walk_lineage", "support_from_records", "extract_support",
     "profile_summary", "format_profile", "counter_track_events",
     "export_profile_trace",
     "latency_summary", "format_latency", "latency_histogram_rows",

@@ -43,6 +43,56 @@ def _rec_at(recs: dict, i: int) -> dict:
     return {k: int(recs[k][i]) for k in _FIELDS if k in recs}
 
 
+def walk_lineage(recs: dict, from_step: int | None = None) -> dict:
+    """Walk parent edges backward through one lane's ring — the shared
+    spine of crash explanation (`explain_crash`) and green-support
+    extraction (`obs/support.py`), factored out so the two cannot drift.
+
+    `recs` is a `ring_records()` dict; `from_step` the DISPATCH INDEX to
+    start from (default: the lane's last recorded dispatch). Returns
+      chain          event records, OLDEST first, ENDING at `from_step`
+      truncated      walk hit a parent overwritten by ring wrap — the
+                     chain is a faithful SUFFIX of the full one
+      root_external  walk reached parent == -1 (scenario row / boot /
+                     host injection): the chain is causally complete
+
+    Raises ValueError on a pre-r10 ring (no lineage columns), an empty
+    ring, or a `from_step` the ring does not hold.
+    """
+    if "parent" not in recs:
+        raise ValueError("no lineage columns: state predates r10 or was "
+                         "built without cfg.trace_cap > 0")
+    steps = np.asarray(recs["step"])
+    n = len(steps)
+    if n == 0:
+        raise ValueError("empty ring — nothing to walk "
+                         "(did the lane ever dispatch?)")
+    by_step = {int(s): i for i, s in enumerate(steps)}
+    if from_step is None:
+        i = n - 1                          # the lane's last dispatch
+    elif int(from_step) in by_step:
+        i = by_step[int(from_step)]
+    else:
+        raise ValueError(f"dispatch step {from_step} is not in the ring "
+                         "(overwritten by wrap, or never recorded)")
+    chain = []
+    truncated = False
+    root_external = False
+    while True:
+        chain.append(_rec_at(recs, i))
+        parent = int(recs["parent"][i])
+        if parent < 0:
+            root_external = True
+            break
+        if parent not in by_step:          # overwritten by ring wrap
+            truncated = True
+            break
+        i = by_step[parent]
+    chain.reverse()
+    return dict(chain=chain, truncated=truncated,
+                root_external=root_external)
+
+
 def happens_before(recs: dict) -> list[tuple[int, int]]:
     """The resolvable happens-before edges of one lane's ring, as
     (parent_step, child_step) dispatch-index pairs. `recs` is a
@@ -105,38 +155,22 @@ def explain_crash(state, lane: int = 0, *, replay: bool = False,
                                    trace_cap=trace_cap,
                                    export_trace=export_trace)
     recs = ring_records(state, lane)
-    if "parent" not in recs:
-        raise ValueError("no lineage columns: state predates r10 or was "
-                         "built without cfg.trace_cap > 0")
-    n = len(np.asarray(recs["step"]))
-    if n == 0:
-        raise ValueError(f"lane {lane} recorded no events — nothing to "
-                         "explain (did the lane ever dispatch?)")
-    by_step = {int(s): i for i, s in enumerate(recs["step"])}
-    chain = []
-    i = n - 1                              # the lane's last dispatch
-    truncated = False
-    root_external = False
-    while True:
-        chain.append(_rec_at(recs, i))
-        parent = int(recs["parent"][i])
-        if parent < 0:
-            root_external = True
-            break
-        if parent not in by_step:          # overwritten by ring wrap
-            truncated = True
-            break
-        i = by_step[parent]
-    chain.reverse()
+    try:
+        walk = walk_lineage(recs)
+    except ValueError as e:
+        if "empty ring" in str(e):
+            raise ValueError(f"lane {lane} recorded no events — nothing "
+                             "to explain (did the lane ever dispatch?)")
+        raise
 
     def _lane_scalar(leaf):
         a = np.asarray(leaf)
         return a[lane] if a.ndim else a
 
     return dict(
-        chain=chain,
-        truncated=truncated,
-        root_external=root_external,
+        chain=walk["chain"],
+        truncated=walk["truncated"],
+        root_external=walk["root_external"],
         crashed=bool(_lane_scalar(state.crashed)),
         crash_code=int(_lane_scalar(state.crash_code)),
         crash_node=int(_lane_scalar(state.crash_node)),

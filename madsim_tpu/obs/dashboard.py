@@ -422,6 +422,12 @@ def render_html(cur: dict, diff: dict | None = None,
                        attr.get("operator_coverage", {}))}
 {attribution_bars_html("Buckets by operator",
                        attr.get("operator_buckets", {}))}
+{attribution_bars_html("Coverage by origin",
+                       attr.get("origin_coverage", {}),
+                       ["targeted", "havoc"])}
+{attribution_bars_html("Buckets by origin",
+                       attr.get("origin_buckets", {}),
+                       ["targeted", "havoc"])}
 </div>
 {series_sparklines_html(cur.get("series"))}
 <h2>Buckets — lifecycle, attribution, repro health</h2>

@@ -35,6 +35,19 @@ def _lat(rec) -> str:
     return txt
 
 
+def _targeted(rec) -> str:
+    """Render the round's targeted-arm column ("  targeted 3/16 pool 8":
+    admitted/launched lineage-synthesized lanes plus the support pool
+    depth) — empty when the record predates the ldfi plane (r22) or the
+    campaign never aimed."""
+    if rec.get("targeted") is None:
+        return ""
+    txt = f"  targeted {rec.get('targeted_yield', 0)}/{rec['targeted']}"
+    if rec.get("support_pool"):
+        txt += f" pool {rec['support_pool']}"
+    return txt
+
+
 def _top_yield(op_yield) -> str:
     """Render the most productive mutation operator of a round/shard
     ("  yield time_nudge:3") — empty when nothing was admitted or the
@@ -134,7 +147,8 @@ class ProgressObserver:
             f"round {rec['round']:>3}  +{rec['new_schedules']} new "
             f"schedules ({rec['distinct_total']} distinct)  "
             f"crashes {rec['crashes']}{corpus}{shards}{_lat(rec)}"
-            f"{_top_yield(rec.get('op_yield'))}", force=True)
+            f"{_top_yield(rec.get('op_yield'))}{_targeted(rec)}",
+            force=True)
         if rec.get("shards", 1) > 1 and rec.get("per_shard"):
             # one row per shard — a mesh campaign's telemetry must not
             # collapse the mesh into one line (wall_s is the round's

@@ -9,16 +9,22 @@ schedule space instead of sampling it.
   shard.py    the mesh-sharded campaign driver (r13): device-local
               corpus shards, on-device mutation fan-out, all-gather
               coverage merge
+  ldfi.py     lineage-driven fault targeting (r22): green-run support
+              pooling + hitting-set scenario synthesis, armed via
+              fuzz(ldfi=LdfiConfig(...)) / fuzz_sharded(ldfi=...)
 
-See DESIGN.md §11 "Search discipline" and §15 "Sharding discipline".
+See DESIGN.md §11 "Search discipline", §15 "Sharding discipline", and
+§23 "Targeted-fault discipline".
 """
 
 from .corpus import Corpus, merge_consensus
 from .fuzz import fuzz
+from .ldfi import LdfiConfig, SupportPool, synthesize
 from .mutate import N_MUT_OPS, OP_NAMES, KnobPlan
 from .pct import pct_sweep, with_prio_nudge
 from .shard import fuzz_sharded, shard_worker_id
 
 __all__ = ["Corpus", "KnobPlan", "fuzz", "fuzz_sharded", "pct_sweep",
            "with_prio_nudge", "merge_consensus", "shard_worker_id",
-           "OP_NAMES", "N_MUT_OPS"]
+           "OP_NAMES", "N_MUT_OPS",
+           "LdfiConfig", "SupportPool", "synthesize"]

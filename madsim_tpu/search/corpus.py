@@ -233,7 +233,8 @@ class Corpus:
     # ------------------------------------------------------------------
     def observe(self, knobs_batch, seeds, hashes_u64, crashed, codes,
                 parent_ids, round_no: int, sketches=None,
-                last_op=None, lat_p99=None, burst=None) -> dict:
+                last_op=None, lat_p99=None, burst=None,
+                origin=None) -> dict:
         """Fold one harvested round into the corpus. `knobs_batch` is the
         HOST knob batch that ran, `hashes_u64` the per-lane schedule
         hashes, `parent_ids` the corpus entry id each lane mutated from
@@ -248,13 +249,21 @@ class Corpus:
         int[B] per-lane deepest-transient-spike metric
         (parallel.stats.lane_burst off the windowed series — enables
         the opt-in burst admission bonus when self.burst_bonus > 0).
-        Returns
+        `origin` the optional bool[B] LDFI mask (search/ldfi.py):
+        True marks a lane that ran a lineage-targeted vector — its
+        admitted entry is tagged `origin="targeted"` (an ADDITIVE key:
+        havoc entries carry no origin at all, so campaigns without the
+        LDFI arm stay byte-identical at the store level) and the stats
+        gain `targeted_yield`, targeted admissions counted the same way
+        op_yield's "base" slot counts them (a targeted lane's last_op
+        is -1). Returns
         admission stats; with `last_op` given they include `op_yield` —
         admissions attributed by operator (int64[N_MUT_OPS + 1], last
         slot = "base"), summing exactly to `new`: which operators'
         mutants actually bought coverage, not just which ran."""
         new = 0
         new_crash_codes = []
+        targeted_yield = 0
         op_yield = (np.zeros(N_MUT_OPS + 1, np.int64)
                     if last_op is not None else None)
         div_slot = None
@@ -330,6 +339,9 @@ class Corpus:
                          energy=min(self.energy_cap, energy),
                          round=int(round_no), div_slot=slot,
                          crash_code=int(codes[i]) if hit_crash else 0)
+            if origin is not None and bool(origin[i]):
+                entry["origin"] = "targeted"
+                targeted_yield += 1
             self._next_id += 1
             self._insert(entry)
             if self.track_admissions:
@@ -342,6 +354,8 @@ class Corpus:
                    new_crash_codes=new_crash_codes)
         if op_yield is not None:
             out["op_yield"] = op_yield
+        if origin is not None:
+            out["targeted_yield"] = targeted_yield
         return out
 
     # ------------------------------------------------------------------

@@ -84,7 +84,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
          burst_bonus: float | None = None,
          corpus_dir: str | None = None,
          worker_id: int = 0, sync_every: int = 1,
-         verify_resume: bool | None = None):
+         verify_resume: bool | None = None, ldfi=None):
     """Coverage-guided schedule fuzzing over `rt`'s dynamic fault knobs.
 
     Round 0 is a blind bootstrap (base knobs, fresh seeds — one explore()
@@ -148,6 +148,20 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                    invocation would fork the campaign from the run that
                    was never killed.
 
+    ldfi (r22, DESIGN §23): a `search.ldfi.LdfiConfig` turns on the
+    lineage-driven arm — green lanes' success supports are extracted
+    from their rings (`obs/support.py`, needs cfg.trace_cap > 0; the
+    witness is `ldfi.witness`), pooled across lanes, and each round
+    after bootstrap gives the LAST `ldfi.frac` of its batch to
+    synthesized targeted vectors (ordinary knob rows — apply/minimize/
+    replay/buckets all work unchanged) while the rest stays havoc.
+    Targeted lanes are a distinct corpus arm: admitted entries carry
+    `origin="targeted"` (additive store field), bucket records an
+    `origin`, round records and worker state a `targeted_yield`
+    counter. The speculative pipeline is disabled (round r+1's
+    synthesis needs round r's rings — the durable-store rationale);
+    ldfi=None is the pre-r22 fuzzer bit for bit, stores included.
+
     observer: obs.metrics.SweepObserver — `on_round` records of kind
     "fuzz_round" (explore's round schema + corpus_size/new_crash_codes),
     `on_done` with the final result; hooks ride the harvest the loop
@@ -163,8 +177,22 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
       corpus_size       corpus entries at the end
       mutation_ops      {operator name: times applied}
       minimized         {code: minimize_knobs info} when minimize=True
+      targeted          (ldfi runs only) {supports, truncated_supports,
+                        lanes_run, admitted} — the lineage arm's ledger
     """
     plan = KnobPlan.from_runtime(rt, dup_slots=dup_slots)
+    pool = None
+    targeted_total = 0
+    targeted_yield_total = 0
+    if ldfi is not None:
+        if rt.cfg.trace_cap <= 0:
+            raise ValueError(
+                "fuzz(ldfi=...) needs the flight recorder compiled in "
+                "(cfg.trace_cap > 0): support extraction walks lineage "
+                "rings — there is nothing to aim without them")
+        from ..obs.support import extract_support
+        from .ldfi import SupportPool, synthesize
+        pool = SupportPool()
     op_hist = np.zeros(N_MUT_OPS, np.int64)
     # cumulative coverage-YIELD attribution (vs op_hist's application
     # counts): admissions credited to the admitted lane's last applied
@@ -225,6 +253,11 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             op_hist[:] = np.asarray(ws["op_hist"], np.int64)
         if ws.get("op_yield"):
             yield_hist[:] = np.asarray(ws["op_yield"], np.int64)
+        if ws.get("targeted_yield") is not None and ldfi is not None:
+            # the support pool itself is NOT persisted — a resumed ldfi
+            # campaign re-harvests green supports (cheap, a few host
+            # walks); only the cumulative admission ledger survives
+            targeted_yield_total = int(ws["targeted_yield"])
     if corpus is None:
         corpus = Corpus(plan, rng=np.random.default_rng(rng_seed),
                         fresh_frac=fresh_frac,
@@ -254,6 +287,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                  + r * batch) % (1 << 32)
         seeds = (np.arange(batch, dtype=np.uint64)
                  + np.uint64(lane0)).astype(np.uint32)
+        targeted = np.zeros(batch, bool)
         if r == 0 or len(corpus) == 0:
             knobs_dev = {k: v for k, v in plan.base_batch(batch).items()}
             ids = np.full(batch, -1, np.int64)
@@ -261,15 +295,51 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             last_op = np.full(batch, -1, np.int64)
         else:
             parents, ids = corpus.schedule(batch)
-            knobs_dev, hist, last_op = plan.mutate(
-                parents, jax.random.fold_in(master, np.uint32(r)),
-                havoc=havoc)
+            key = jax.random.fold_in(master, np.uint32(r))
+            tvecs, tseeds = [], []
+            if pool is not None and len(pool):
+                tvecs, tseeds = synthesize(
+                    plan, pool, min(batch, max(1, int(batch * ldfi.frac))),
+                    max_cuts=ldfi.max_cuts, lead=ldfi.lead,
+                    rank_cap=ldfi.rank_cap, with_seeds=True)
+            if tvecs:
+                # the lineage arm: targeted vectors ride the LAST T
+                # lanes. The masked mutate (the shard driver's kernel —
+                # module-level jit, traced once per shape) leaves those
+                # lanes' parents untouched so the havoc histogram and
+                # last-op attribution count ONLY real mutants; the
+                # synthesized rows then overwrite them host-side and
+                # plan.apply bounds-checks them like any mutant — zero
+                # new compiled programs for a targeted round
+                tn = len(tvecs)
+                mask = np.ones(batch, bool)
+                mask[batch - tn:] = False
+                knobs_dev, hist, last_op = plan.mutate_masked(
+                    parents, key, mask, havoc=havoc)
+                knobs_host = {k: np.asarray(v).copy()
+                              for k, v in knobs_dev.items()}
+                tb = KnobPlan.stack(tvecs)
+                for k in knobs_host:
+                    knobs_host[k][batch - tn:] = tb[k]
+                knobs_dev = knobs_host
+                ids = ids.copy()
+                ids[batch - tn:] = -1     # no havoc parent to reward
+                targeted[batch - tn:] = True
+                # pin each targeted lane to the green seed its cut was
+                # aimed at: edge instants are seed-specific, so the cut
+                # only lands inside the trajectory it was extracted from
+                for j, ts_seed in enumerate(tseeds):
+                    if ts_seed is not None:
+                        seeds[batch - tn + j] = np.uint32(ts_seed)
+            else:
+                knobs_dev, hist, last_op = plan.mutate(parents, key,
+                                                       havoc=havoc)
         state = plan.apply(rt.init_batch(seeds), knobs_dev)
         if fused:
             state = rt.run_fused(state, max_steps, chunk)
         else:
             state, _ = rt.run(state, max_steps, chunk)
-        return seeds, ids, knobs_dev, hist, last_op, state
+        return seeds, ids, knobs_dev, hist, last_op, targeted, state
 
     def harvest(launched):
         """Block on one round. Transfers the [B] hash/crash lanes plus
@@ -277,7 +347,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         attribution, unlike explore()'s O(distinct) digest) and, when
         the build compiles the prefix sketch in, the [B, S] sketch
         batch (also kilobytes — the divergence-depth signal)."""
-        seeds, ids, knobs_dev, hist, last_op, state = launched
+        seeds, ids, knobs_dev, hist, last_op, targeted, state = launched
         knobs_host = {k: np.asarray(v) for k, v in knobs_dev.items()}
         hashes = stats.sched_hash_u64(state)
         sk = np.asarray(state.cov_sketch)
@@ -300,7 +370,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         return (seeds, ids, knobs_host, hashes,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
                 hist is not None, np.asarray(last_op), sketches, state,
-                lat_p99, lat_brief, burst)
+                lat_p99, lat_brief, burst, targeted)
 
     def verified(harvested):
         """The run-twice resume guard (verify_resume): re-dispatch the
@@ -329,7 +399,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 state, _ = rt.run(state, max_steps, chunk)
             return harvest((seeds, ids, knobs_host,
                             None if not mutated else
-                            np.zeros(N_MUT_OPS, np.int64), last_op, state))
+                            np.zeros(N_MUT_OPS, np.int64), last_op,
+                            prev[13], state))
 
         return agree_twice(harvested, again, key_of,
                            what="first post-resume campaign round")
@@ -348,7 +419,7 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
     # r's harvest; a durable campaign must schedule AFTER the sync point
     # (or the persisted rng state couldn't replay the draw), so the store
     # forces the serial loop — multi-worker campaigns restore the overlap
-    speculate = pipeline and fused and store is None
+    speculate = pipeline and fused and store is None and ldfi is None
     t0 = time.perf_counter()
     pending = (launch(round_start)
                if round_start < max_rounds and dry < dry_rounds else None)
@@ -362,13 +433,38 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
         if r == verify_round:
             harvested = verified(harvested)
         (seeds, ids, knobs_host, hashes, crashed, codes, mutated,
-         last_op, sketches, state, lat_p99, lat_brief, burst) = harvested
+         last_op, sketches, state, lat_p99, lat_brief, burst,
+         targeted) = harvested
         rounds += 1
         cstats = corpus.observe(knobs_host, seeds, hashes, crashed, codes,
                                 ids, r, sketches=sketches,
                                 last_op=last_op, lat_p99=lat_p99,
-                                burst=burst)
+                                burst=burst,
+                                origin=targeted if ldfi is not None
+                                else None)
         yield_hist[:] += cstats["op_yield"]
+        if ldfi is not None:
+            targeted_total += int(targeted.sum())
+            targeted_yield_total += int(cstats.get("targeted_yield", 0))
+            if len(pool) < ldfi.lanes:
+                # harvest green supports: UNMUTATED lanes (bootstrap or
+                # havoc no-ops; last_op < 0, not targeted) that did not
+                # crash — the undisturbed trajectories whose success
+                # support is worth cutting. Bounded: the pool stops
+                # growing at ldfi.lanes supports, so the per-lane host
+                # walks are a one-time cost, not a per-round tax
+                for i in range(len(seeds)):
+                    if len(pool) >= ldfi.lanes:
+                        break
+                    if (bool(crashed[i]) or int(last_op[i]) >= 0
+                            or bool(targeted[i])):
+                        continue
+                    sup = extract_support(
+                        state, int(i), witness=ldfi.witness,
+                        replay=ldfi.replay, rt=rt, seed=int(seeds[i]),
+                        knobs=KnobPlan.lane(knobs_host, int(i)))
+                    if sup is not None:
+                        pool.add(sup, seed=int(seeds[i]))
         for i in np.nonzero(crashed)[0]:
             c = int(codes[i])
             if not mutated:     # seed-alone handles: bootstrap lanes only
@@ -379,14 +475,18 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                                  script=plan.to_scenario(kn).describe())
         if buckets is not None and crashed.any():
             # dedup crashes into causal-fingerprint buckets: one
-            # representative lane per distinct crash code per round keeps
-            # the host-side explain work bounded (the chain walk is
-            # O(trace_cap) per lane; codes, not lanes, are the cheap
-            # first partition — the fingerprint then splits bugs sharing
-            # a code across rounds)
-            coded: set[int] = set()
+            # representative lane per distinct (crash code, origin) per
+            # round keeps the host-side explain work bounded (the chain
+            # walk is O(trace_cap) per lane; codes, not lanes, are the
+            # cheap first partition — the fingerprint then splits bugs
+            # sharing a code across rounds). The origin axis matters:
+            # targeted lanes ride the batch TAIL, so a code-only dedup
+            # would always hand representation to an earlier havoc lane
+            # and the targeted arm could never open a bucket it earned
+            coded: set[tuple] = set()
             for i in np.nonzero(crashed)[0]:
-                c = int(codes[i])
+                c = (int(codes[i]),
+                     bool(targeted[int(i)]) if ldfi is not None else False)
                 if c in coded:
                     continue
                 coded.add(c)
@@ -394,7 +494,9 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                     state, int(i), seed=int(seeds[i]),
                     knobs=KnobPlan.lane(knobs_host, int(i)),
                     round_no=r, worker_id=worker_id,
-                    last_op=int(last_op[int(i)]))
+                    last_op=int(last_op[int(i)]),
+                    origin=(("targeted" if targeted[int(i)] else "havoc")
+                            if ldfi is not None else None))
                 if opened:
                     opened_buckets.append(key)
         n_crashed += int(crashed.sum())
@@ -420,6 +522,15 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                           for i in range(len(YIELD_NAMES))},
                 corpus_energy=corpus.energy_summary(),
                 dry_rounds=dry, wall_s=time.perf_counter() - t0)
+            if ldfi is not None:
+                # the lineage arm's round ledger: lanes given to
+                # targeted vectors, their admissions (the slice of
+                # `admitted` that was aimed, not sprayed), and the
+                # support pool's size/honesty
+                rec.update(targeted=int(targeted.sum()),
+                           targeted_yield=int(
+                               cstats.get("targeted_yield", 0)),
+                           support_pool=len(pool))
             if lat_brief is not None:
                 # the round's tail (obs/metrics.py schema): merged e2e
                 # p50/p99 estimates + SLO misses for this round's batch
@@ -451,6 +562,8 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                 crashes=n_crashed, corpus_size=len(corpus),
                 dry=dry, wall_s=round(wall_now, 3),
                 op_yield=[int(x) for x in yield_hist])
+            if ldfi is not None:
+                mrow["targeted_yield"] = targeted_yield_total
             if lat_brief is not None:
                 # the durable p99 timeline (campaign_report folds the
                 # rows into a p99_curve): this sync's round-batch tail
@@ -458,7 +571,9 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
             store.append_metrics(worker_id, mrow)
             store.sync(corpus, worker_id, rounds_done=r + 1, dry=dry,
                        op_hist=op_hist, op_yield=yield_hist,
-                       wall_s=wall_now)
+                       wall_s=wall_now,
+                       targeted_yield=(targeted_yield_total
+                                       if ldfi is not None else None))
         if dry >= dry_rounds:
             break
         pending = nxt if nxt is not None else (
@@ -484,6 +599,10 @@ def fuzz(rt, max_steps: int, batch: int = 512, max_rounds: int = 16,
                         for i in range(len(YIELD_NAMES))},
         corpus_energy=corpus.energy_summary(),
     )
+    if ldfi is not None:
+        result["targeted"] = dict(
+            supports=len(pool), truncated_supports=pool.truncated,
+            lanes_run=targeted_total, admitted=targeted_yield_total)
     if store is not None:
         result.update(
             corpus_dir=store.dir,

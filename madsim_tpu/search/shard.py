@@ -91,7 +91,8 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                  lat_bonus: float | None = None,
                  burst_bonus: float | None = None, merge_every: int = 1,
                  corpus_dir: str | None = None, worker_id: int = 0,
-                 sync_every: int = 1, verify_resume: bool | None = None):
+                 sync_every: int = 1, verify_resume: bool | None = None,
+                 ldfi=None):
     """Coverage-guided schedule fuzzing, sharded across a device mesh.
 
     `batch` is PER SHARD: a round runs `shards*batch` lanes as one SPMD
@@ -107,6 +108,19 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
     must be post-merge so a resume restores what the shards knew);
     `verify_resume` adds the run-twice guard on the first post-resume
     round (see `fuzz()`).
+
+    `ldfi` (an `LdfiConfig`, r22) arms the lineage-targeted search arm
+    exactly as in `fuzz()`, with ONE support pool shared across the
+    mesh: every shard harvests green supports into it and every shard's
+    targeted tail is synthesized against the pooled hitting set — the
+    cross-shard pooling the single-corpus fuzzer can't do. Targeted
+    rows ride the tail of each mutating shard's lane slice behind the
+    same masked SPMD havoc dispatch (mask off ⇒ parents pass through,
+    zero extra compiled programs); the one extra cost is a host
+    round-trip of the round's knob batch to splice the rows in. The
+    pool itself is not persisted across resume — only the cumulative
+    admission ledger (`targeted_yield` in the group state) survives;
+    the pool re-harvests within a round or two.
 
     Returns `fuzz()`'s result schema plus:
       shards        the mesh width
@@ -140,6 +154,18 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
     yield_hist = np.zeros(N_MUT_OPS + 1, np.int64)   # see fuzz()
     if verify_resume is None:
         verify_resume = _env_verify_resume()
+    pool = None
+    targeted_total = 0
+    targeted_yield_total = 0
+    if ldfi is not None:
+        if rt.cfg.trace_cap <= 0:
+            raise ValueError(
+                "fuzz_sharded(ldfi=...) needs the flight recorder "
+                "compiled in (cfg.trace_cap > 0) — support extraction "
+                "walks the causal ring")
+        from ..obs.support import extract_support
+        from .ldfi import SupportPool, synthesize
+        pool = SupportPool()    # ONE pool, shared across the mesh
 
     stores = buckets = None
     tally = None
@@ -187,6 +213,9 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             op_hist[:] = np.asarray(group["op_hist"], np.int64)
         if group and group.get("op_yield"):
             yield_hist[:] = np.asarray(group["op_yield"], np.int64)
+        if group and group.get("targeted_yield") is not None \
+                and ldfi is not None:
+            targeted_yield_total = int(group["targeted_yield"])
         shard_states = group.get("shard_states") if group else None
         corpora = []
         for s in range(S):
@@ -261,19 +290,73 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 np.concatenate([p[k] for p in parent_knobs]),
                 lane_sharding)
             for k in parent_knobs[0]}
+        targeted = np.zeros(batch * S, bool)
         if any(mutated):
             # one SPMD havoc dispatch for the whole mesh: bootstrap
             # shards' lanes pass through unmutated via the mask (and
             # never count in the histogram); the mutation math
             # partitions over the lane axis — it never leaves each
             # shard's device, and one executable serves the mesh width
-            mask = jax.device_put(
-                np.repeat(np.asarray(mutated, bool), batch),
-                lane_sharding)
+            mask_np = np.repeat(np.asarray(mutated, bool), batch)
+            deal = None
+            if pool is not None and len(pool):
+                # the targeted arm (r22): synthesize against the ONE
+                # mesh-shared pool, deal the vectors round-robin over
+                # the mutating shards' lane-slice tails, and mask those
+                # tails off — the SPMD havoc dispatch passes their
+                # parents through (hist/last_op count real mutants
+                # only) and the rows are spliced in host-side below
+                per = min(batch, max(1, int(batch * ldfi.frac)))
+                mut_idx = [s for s in range(S) if mutated[s]]
+                tvecs, tseeds = synthesize(plan, pool, per * len(mut_idx),
+                                           max_cuts=ldfi.max_cuts,
+                                           lead=ldfi.lead,
+                                           rank_cap=ldfi.rank_cap,
+                                           with_seeds=True)
+                if tvecs:
+                    deal = [[] for _ in range(S)]
+                    deal_seeds = [[] for _ in range(S)]
+                    for j, v in enumerate(tvecs):
+                        s = mut_idx[j % len(mut_idx)]
+                        if len(deal[s]) < per:
+                            deal[s].append(v)
+                            deal_seeds[s].append(tseeds[j])
+                    for s in mut_idx:
+                        tn = len(deal[s])
+                        if tn:
+                            lo = (s + 1) * batch - tn
+                            hi = (s + 1) * batch
+                            mask_np[lo:hi] = False
+                            targeted[lo:hi] = True
+                            # pin targeted lanes to the green seeds
+                            # their cuts were timed against (edge
+                            # instants are seed-specific)
+                            for j, ts_seed in enumerate(deal_seeds[s]):
+                                if ts_seed is not None:
+                                    seeds[lo + j] = np.uint32(ts_seed)
+            mask = jax.device_put(mask_np, lane_sharding)
             knobs_dev, hist, last_op = plan.mutate_masked(
                 parents_global,
                 jax.random.fold_in(master, np.uint32(r)), mask,
                 havoc=havoc)
+            if deal is not None and targeted.any():
+                # splice the synthesized rows over the masked tails —
+                # one host round-trip of the knob batch, the targeted
+                # arm's only extra cost (zero new compiled programs:
+                # apply/run see an ordinary mesh-sharded knob dict)
+                spliced = {k: np.asarray(v).copy()
+                           for k, v in knobs_dev.items()}
+                for s in range(S):
+                    tn = len(deal[s])
+                    if not tn:
+                        continue
+                    lo, hi = (s + 1) * batch - tn, (s + 1) * batch
+                    stacked = KnobPlan.stack(deal[s])
+                    for k in spliced:
+                        spliced[k][lo:hi] = stacked[k]
+                    ids[lo:hi] = -1      # synthesized, not a parent's kid
+                knobs_dev = {k: jax.device_put(v, lane_sharding)
+                             for k, v in spliced.items()}
         else:
             knobs_dev, hist = parents_global, None
             last_op = np.full(batch * S, -1, np.int64)
@@ -293,13 +376,14 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         # the all-gathered O(distinct) coverage digest (queued async):
         # campaign-global dedup without shipping [S*B] hashes per round
         pairs, n = stats.coverage_digest(state)
-        return seeds, ids, knobs_dev, hist, last_op, mutated, state, pairs, n
+        return (seeds, ids, knobs_dev, hist, last_op, mutated, targeted,
+                state, pairs, n)
 
     def harvest(launched):
         """Block on one round. Per-shard corpora read their own [batch]
         hash/crash/knob lanes (kilobytes per shard — the same bill
         fuzz() pays); the global dedup reads only the digest prefix."""
-        (seeds, ids, knobs_dev, hist, last_op, mutated, state,
+        (seeds, ids, knobs_dev, hist, last_op, mutated, targeted, state,
          pairs, n) = launched
         knobs_host = {k: np.asarray(v) for k, v in knobs_dev.items()}
         hashes = stats.sched_hash_u64(state)
@@ -318,10 +402,12 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         burst = stats.lane_burst(state)
         if hist is not None:
             op_hist[:] += np.asarray(hist)
+        # `targeted` rides LAST so _verified_harvest's positional
+        # key_of indices stay valid
         return (seeds, ids, knobs_host, hashes, digest,
                 np.asarray(state.crashed), np.asarray(state.crash_code),
                 mutated, np.asarray(last_op), sketches, state,
-                lat_p99, lat_brief, burst)
+                lat_p99, lat_brief, burst, targeted)
 
     def do_merge():
         """The cross-shard exchange: admissions since the last merge
@@ -362,11 +448,15 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             op_yield=[int(x) for x in yield_hist])
         if lat_brief is not None:
             mrow.update(_lat_fields(lat_brief))
+        if ldfi is not None:
+            mrow["targeted_yield"] = targeted_yield_total
         stores[0].append_metrics(worker_id, mrow, group=True)
         stores[0].write_shard_group_state(
             corpora, worker_id=worker_id, shards=S,
             rounds_done=rounds_done, dry=dry_now, op_hist=op_hist,
-            wall_s=wall_s, tally=tally, op_yield=yield_hist)
+            wall_s=wall_s, tally=tally, op_yield=yield_hist,
+            targeted_yield=(targeted_yield_total if ldfi is not None
+                            else None))
         return merged
 
     # global coverage frontier: on resume, the union of every shard's
@@ -390,7 +480,9 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         seen_crash_codes |= c.crash_codes
     new_per_round: list[int] = []
     rounds = 0
-    speculate = pipeline and fused and stores is None
+    # speculation launches r+1 before r is harvested; the targeted arm
+    # needs r's green supports IN the pool before synthesizing r+1
+    speculate = pipeline and fused and stores is None and ldfi is None
     t0 = time.perf_counter()
     pending = (launch(round_start)
                if round_start < max_rounds and dry < dry_rounds else None)
@@ -405,12 +497,14 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
             harvested = _verified_harvest(
                 rt, plan, harvested, harvest, max_steps, chunk, fused, mesh)
         (seeds, ids, knobs_host, hashes, digest, crashed, codes, mutated,
-         last_op, sketches, state, lat_p99, lat_brief, burst) = harvested
+         last_op, sketches, state, lat_p99, lat_brief, burst,
+         targeted) = harvested
         rounds += 1
         corpus_size = 0
         per_shard_rows = []
         round_new_codes: list[int] = []
         round_yield = np.zeros(N_MUT_OPS + 1, np.int64)
+        round_targeted_yield = 0
         for s in range(S):
             lo, hi = s * batch, (s + 1) * batch
             sk_s = sketches[lo:hi] if sketches is not None else None
@@ -419,8 +513,10 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 seeds[lo:hi], hashes[lo:hi], crashed[lo:hi], codes[lo:hi],
                 ids[lo:hi], r, sketches=sk_s, last_op=last_op[lo:hi],
                 lat_p99=(lat_p99[lo:hi] if lat_p99 is not None else None),
-                burst=(burst[lo:hi] if burst is not None else None))
+                burst=(burst[lo:hi] if burst is not None else None),
+                origin=(targeted[lo:hi] if ldfi is not None else None))
             round_yield += cstats["op_yield"]
+            round_targeted_yield += int(cstats.get("targeted_yield", 0))
             shard_seen[s] |= set(hashes[lo:hi].tolist())
             corpus_size += cstats["size"]
             shard_crashes[s] += int(crashed[lo:hi].sum())
@@ -444,6 +540,25 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 crashes=int(crashed[lo:hi].sum()),
                 seeds_run=rounds * batch))
         yield_hist[:] += round_yield
+        if ldfi is not None:
+            targeted_total += int(targeted.sum())
+            targeted_yield_total += round_targeted_yield
+            if len(pool) < ldfi.lanes:
+                # harvest green supports into the mesh-shared pool:
+                # untouched (last_op == -1), uncrashed, un-aimed lanes
+                # from ANY shard — bounded one-time host ring walks
+                for i in range(len(seeds)):
+                    if len(pool) >= ldfi.lanes:
+                        break
+                    if (bool(crashed[i]) or int(last_op[i]) >= 0
+                            or bool(targeted[i])):
+                        continue
+                    sup = extract_support(
+                        state, int(i), witness=ldfi.witness,
+                        replay=ldfi.replay, rt=rt, seed=int(seeds[i]),
+                        knobs=KnobPlan.lane(knobs_host, int(i)))
+                    if sup is not None:
+                        pool.add(sup, seed=int(seeds[i]))
         for i in np.nonzero(crashed)[0]:
             c = int(codes[i])
             if not mutated[int(i) // batch]:
@@ -453,9 +568,13 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 repros[c] = dict(seed=int(seeds[i]), round=r, knobs=kn,
                                  script=plan.to_scenario(kn).describe())
         if buckets is not None and crashed.any():
-            coded: set[int] = set()
+            # one representative per (code, origin) per round — a
+            # code-only dedup would always elect an earlier havoc lane
+            # over the tail-riding targeted lanes (see fuzz.py)
+            coded: set[tuple] = set()
             for i in np.nonzero(crashed)[0]:
-                c = int(codes[i])
+                c = (int(codes[i]),
+                     bool(targeted[int(i)]) if ldfi is not None else False)
                 if c in coded:
                     continue
                 coded.add(c)
@@ -463,7 +582,9 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                     state, int(i), seed=int(seeds[i]),
                     knobs=KnobPlan.lane(knobs_host, int(i)),
                     round_no=r, worker_id=eff_w[int(i) // batch],
-                    last_op=int(last_op[int(i)]))
+                    last_op=int(last_op[int(i)]),
+                    origin=(("targeted" if targeted[int(i)] else "havoc")
+                            if ldfi is not None else None))
                 if opened:
                     opened_buckets.append(key)
         n_crashed += int(crashed.sum())
@@ -488,6 +609,10 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
                 dry_rounds=dry, wall_s=time.perf_counter() - t0)
             if lat_brief is not None:
                 rec.update(_lat_fields(lat_brief))
+            if ldfi is not None:
+                rec.update(targeted=int(targeted.sum()),
+                           targeted_yield=round_targeted_yield,
+                           support_pool=len(pool))
             if buckets is not None:
                 rec["buckets_opened"] = len(opened_buckets)
             if sketches is not None:
@@ -534,6 +659,10 @@ def fuzz_sharded(rt, max_steps: int, batch: int = 512, shards: int | None
         mutation_yield={YIELD_NAMES[i]: int(yield_hist[i])
                         for i in range(len(YIELD_NAMES))},
     )
+    if ldfi is not None:
+        result["targeted"] = dict(
+            supports=len(pool), truncated_supports=pool.truncated,
+            lanes_run=targeted_total, admitted=targeted_yield_total)
     if stores is not None:
         result.update(
             corpus_dir=stores[0].dir,
@@ -596,7 +725,7 @@ def _verified_harvest(rt, plan, harvested, harvest_fn, max_steps, chunk,
         # The knob batch was never donated, so re-placing the host copy
         # over the mesh re-dispatches the identical round.
         seeds, ids, knobs_host, mutated = prev[0], prev[1], prev[2], prev[7]
-        last_op = prev[8]
+        last_op, targeted = prev[8], prev[14]
         sharding = NamedSharding(mesh, P(SEED_AXIS))
         knobs_dev = {k: jax.device_put(v, sharding)
                      for k, v in knobs_host.items()}
@@ -612,7 +741,7 @@ def _verified_harvest(rt, plan, harvested, harvest_fn, max_steps, chunk,
             state, _ = rt.run(state, max_steps, chunk)
         pairs, n = stats.coverage_digest(state)
         return harvest_fn((seeds, ids, knobs_dev, None, last_op,
-                           mutated, state, pairs, n))
+                           mutated, targeted, state, pairs, n))
 
     return agree_twice(harvested, again, key_of,
                        what="first post-resume campaign round")

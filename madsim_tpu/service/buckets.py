@@ -72,7 +72,8 @@ class CrashBuckets:
                 state=None, lane: int | None = None,
                 nudge: int | None = None,
                 last_op: int | None = None,
-                chain_truncated: bool | None = None) -> tuple[str, bool]:
+                chain_truncated: bool | None = None,
+                origin: str | None = None) -> tuple[str, bool]:
         """Fold one crash observation in. Returns (bucket key, opened):
         `opened` is True when this observation created a new bucket (and
         wrote its repro + trace artifacts); an observation matching an
@@ -91,6 +92,15 @@ class CrashBuckets:
         the triage plane's per-operator bucket attribution; buckets
         without it (pre-r18, or races) attribute to the explicit
         `base` class.
+
+        `origin` (r22) records which SEARCH ARM produced the crashing
+        lane's knob vector — "targeted" (a lineage-synthesized vector,
+        search/ldfi.py) or "havoc" — into the bucket record and every
+        telemetry line, the triage plane's targeted-vs-havoc bucket
+        attribution. Additive: buckets observed without it (pre-r22, or
+        ldfi-less campaigns) carry no origin field and triage
+        attributes them to "havoc" (factually honest — nothing before
+        r22 ever aimed).
 
         `chain_truncated` (r20) records whether this observation's
         chain was cut at ring wrap. Completeness UPGRADE rule: an
@@ -119,6 +129,8 @@ class CrashBuckets:
                 created_at=time.time())
             if last_op is not None:
                 rec["op"] = int(last_op)
+            if origin is not None:
+                rec["origin"] = str(origin)
             if chain_truncated is not None:
                 rec["chain_truncated"] = bool(chain_truncated)
             self.store.write_bucket(key, rec, knobs=knobs)
@@ -141,17 +153,21 @@ class CrashBuckets:
                     rec["chain_truncated"] = bool(chain_truncated)
                 self.store.write_bucket(key, rec)   # no knobs: the
                 self._index[key] = rec              # canonical repro stays
-        self.store.append_bucket_log(dict(
+        line = dict(
             kind="crash", bucket=key, fp_key=fp["key"],
             crash_code=fp["crash_code"], seed=int(seed),
             round=int(round_no), worker_id=int(worker_id),
-            opened=bool(opened)))
+            opened=bool(opened))
+        if origin is not None:
+            line["origin"] = str(origin)
+        self.store.append_bucket_log(line)
         return key, opened
 
     def observe_lane(self, state, lane: int, *, seed: int,
                      knobs: dict | None, round_no: int,
                      worker_id: int,
-                     last_op: int | None = None) -> tuple[str, bool]:
+                     last_op: int | None = None,
+                     origin: str | None = None) -> tuple[str, bool]:
         """Fingerprint one crashed lane straight off its ring. Falls back
         to the code fingerprint when the build compiled lineage out
         (cfg.trace_cap == 0) — coarser buckets, still deduped."""
@@ -169,7 +185,7 @@ class CrashBuckets:
         return self.observe(fp, seed=seed, knobs=knobs, round_no=round_no,
                             worker_id=worker_id, chain=chain, state=state,
                             lane=lane, last_op=last_op,
-                            chain_truncated=truncated)
+                            chain_truncated=truncated, origin=origin)
 
 
 def merged_buckets(store: CorpusStore, log: list | None = None) -> list[dict]:

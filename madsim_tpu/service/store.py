@@ -316,6 +316,12 @@ class CorpusStore:
             div_slot=np.int64(-1 if entry.get("div_slot") is None
                               else entry["div_slot"]),
             crash_code=np.int64(entry.get("crash_code", 0)))
+        # ADDITIVE r22 field: only lineage-targeted admissions carry an
+        # origin member at all — a campaign without the LDFI arm writes
+        # byte-identical files to a pre-r22 store, and pre-r22 readers
+        # ignore unknown members by construction (np.load key access)
+        if entry.get("origin"):
+            arrays["origin"] = np.str_(entry["origin"])
         _atomic_npz(os.path.join(self.entries_dir,
                                  self._entry_name(entry["id"])), arrays)
 
@@ -324,11 +330,14 @@ class CorpusStore:
             knobs = {k[5:]: np.array(z[k]) for k in z.files
                      if k.startswith("knob_")}
             div = int(z["div_slot"])
-            return dict(id=int(z["id"]), hash=int(z["hash"]),
-                        seed=int(z["seed"]), energy=float(z["energy0"]),
-                        round=int(z["round"]),
-                        div_slot=None if div < 0 else div,
-                        crash_code=int(z["crash_code"]), knobs=knobs)
+            out = dict(id=int(z["id"]), hash=int(z["hash"]),
+                       seed=int(z["seed"]), energy=float(z["energy0"]),
+                       round=int(z["round"]),
+                       div_slot=None if div < 0 else div,
+                       crash_code=int(z["crash_code"]), knobs=knobs)
+            if "origin" in z.files:
+                out["origin"] = str(z["origin"])
+            return out
 
     def entry_names(self) -> list[str]:
         try:
@@ -363,9 +372,10 @@ class CorpusStore:
 
     def write_worker_state(self, corpus: Corpus, worker_id: int,
                            rounds_done: int, dry: int, op_hist,
-                           wall_s: float, op_yield=None) -> None:
+                           wall_s: float, op_yield=None,
+                           targeted_yield=None) -> None:
         self._write_own_entries(corpus, worker_id)
-        _atomic_json(self.worker_state_path(worker_id), dict(
+        st = dict(
             worker_id=int(worker_id),
             rounds_done=int(rounds_done),
             dry=int(dry),
@@ -373,20 +383,29 @@ class CorpusStore:
             op_hist=[int(x) for x in np.asarray(op_hist)],
             op_yield=(None if op_yield is None
                       else [int(x) for x in np.asarray(op_yield)]),
-            **self._scheduler_state(corpus)))
+            **self._scheduler_state(corpus))
+        if targeted_yield is not None:
+            # additive r22 counter (LDFI campaigns only): cumulative
+            # targeted admissions — absent ⇒ byte-identical pre-r22 json
+            st["targeted_yield"] = int(targeted_yield)
+        _atomic_json(self.worker_state_path(worker_id), st)
 
     def write_shard_group_state(self, corpora, worker_id: int, shards: int,
                                 rounds_done: int, dry: int, op_hist,
                                 wall_s: float, tally=None,
-                                op_yield=None) -> None:
+                                op_yield=None,
+                                targeted_yield=None) -> None:
         """Persist a sharded worker's WHOLE group as one atomic write:
         per-shard scheduler states (namespaced worker_id*shards+s), the
         shared round/dry/wall counters, and the cross-shard consensus
         tally. Top-level rounds_done/wall_s keep campaign_stats readers
         working unchanged. Entry files must already be on disk
         (`persist_entries` per shard) — the group json is the commit
-        point, exactly like a worker state."""
-        _atomic_json(self.shard_group_path(worker_id), dict(
+        point, exactly like a worker state. `targeted_yield` (r22) is
+        the group's cumulative targeted-arm admission count — written
+        only when the campaign aimed (additive; ldfi-less group jsons
+        stay byte-identical)."""
+        st = dict(
             worker_id=int(worker_id),
             shards=int(shards),
             rounds_done=int(rounds_done),
@@ -401,7 +420,10 @@ class CorpusStore:
             shard_states=[
                 dict(worker_id=int(c.worker_id),
                      **self._scheduler_state(c))
-                for c in corpora]))
+                for c in corpora])
+        if targeted_yield is not None:
+            st["targeted_yield"] = int(targeted_yield)
+        _atomic_json(self.shard_group_path(worker_id), st)
 
     def load_shard_group_state(self, worker_id: int) -> dict:
         p = self.shard_group_path(worker_id)
@@ -500,14 +522,16 @@ class CorpusStore:
         return admitted
 
     def sync(self, corpus: Corpus, worker_id: int, rounds_done: int,
-             dry: int, op_hist, wall_s: float, op_yield=None) -> dict:
+             dry: int, op_hist, wall_s: float, op_yield=None,
+             targeted_yield=None) -> dict:
         """One durability point: merge other workers' new entries, then
         persist this worker's admissions and scheduler state. Called at
         round boundaries (fuzz(..., sync_every=)); everything between two
         syncs is re-derived deterministically on resume."""
         merged = self.merge_foreign(corpus)
         self.write_worker_state(corpus, worker_id, rounds_done, dry,
-                                op_hist, wall_s, op_yield=op_yield)
+                                op_hist, wall_s, op_yield=op_yield,
+                                targeted_yield=targeted_yield)
         return dict(merged_foreign=merged)
 
     # -- read-only reporting -------------------------------------------

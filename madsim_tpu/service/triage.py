@@ -70,11 +70,19 @@ from .store import CorpusStore, _atomic_bytes
 TRIAGE_FORMAT = "madsim-triage"
 # v2 (r20): bucket rows carry chain_complete + window_trace, audit
 # rows carry chain_complete — additive; v1 snapshots still diff cleanly
-TRIAGE_VERSION = 2
+# v3 (r22): attribution gains the origin axis (origin_coverage /
+# origin_buckets: lineage-targeted vs havoc, search/ldfi.py) and bucket
+# rows carry `origin` — additive; v2 snapshots still diff cleanly
+TRIAGE_VERSION = 3
 
 # the explicit unattributable class (accounting contract above)
 BASE_CLASS = "base"
 ATTR_FAMILIES = RECIPE_FAMILIES + (BASE_CLASS,)
+
+# the r22 origin axis: which search arm produced an admission/bucket.
+# Anything without a recorded origin (pre-r22 stores, ldfi-less
+# campaigns) is "havoc" — factually honest, nothing before r22 aimed.
+ORIGIN_CLASSES = ("targeted", "havoc")
 
 
 # ---------------------------------------------------------------------------
@@ -263,25 +271,30 @@ def triage_snapshot(store_or_dir, quiet_rounds: int = 2) -> tuple[int, dict]:
     by_ns, top_states = _scheduler_states(store)
     entry_files = _committed_entries(store, by_ns)
 
-    # -- coverage + per-recipe / per-operator attribution ---------------
+    # -- coverage + per-recipe / per-operator / per-origin attribution --
     recipe_cov = {f: 0 for f in ATTR_FAMILIES}
+    origin_cov = {o: 0 for o in ORIGIN_CLASSES}
     claimed: set[int] = set()
     for name in entry_files:
         got = store._triage_cache.get(name)
         # a classification cached while ROWS.json was still absent is
         # provisional (fam None): reclassify once the table appears —
-        # entry files are immutable, so everything else caches forever
-        if got is None or (got[1] is None and rows is not None):
+        # entry files are immutable, so everything else caches forever.
+        # Pre-r22 cache tuples (len 2, no origin slot) reload once.
+        if got is None or (got[1] is None and rows is not None) \
+                or len(got) < 3:
             e = store.load_entry(name)
             got = (int(e["hash"]),
                    None if rows is None
-                   else classify_knobs(rows, e["knobs"]))
+                   else classify_knobs(rows, e["knobs"]),
+                   e.get("origin") or "havoc")
             store._triage_cache[name] = got
         h, fam = got[0], (BASE_CLASS if got[1] is None else got[1])
         if h in claimed:
             continue                    # first claim wins (sorted walk)
         claimed.add(h)
         recipe_cov[fam] += 1
+        origin_cov[got[2] if got[2] in ORIGIN_CLASSES else "havoc"] += 1
 
     op_cov = {n: 0 for n in YIELD_NAMES}
     attributed_ns: set[int] = set()
@@ -321,6 +334,7 @@ def triage_snapshot(store_or_dir, quiet_rounds: int = 2) -> tuple[int, dict]:
             int(line.get("worker_id", 0)))
     recipe_bk = {f: 0 for f in ATTR_FAMILIES}
     op_bk = {n: 0 for n in YIELD_NAMES}
+    origin_bk = {o: 0 for o in ORIGIN_CLASSES}
     buckets = {}
     for m in merged:
         fam = BASE_CLASS
@@ -331,8 +345,11 @@ def triage_snapshot(store_or_dir, quiet_rounds: int = 2) -> tuple[int, dict]:
             except (FileNotFoundError, KeyError):
                 fam = BASE_CLASS        # race-only / repro-less bucket
         opn = _op_name(m.get("op"))
+        ogn = m.get("origin") if m.get("origin") in ORIGIN_CLASSES \
+            else "havoc"
         recipe_bk[fam] += 1
         op_bk[opn] += 1
+        origin_bk[ogn] += 1
         rounds = obs_rounds.get(m["key"], [m["repro"].get("round", 0)])
         # r20: chain completeness + the replayed-window trace link.
         # chain_truncated is the recorded truth when present (r20+
@@ -350,6 +367,7 @@ def triage_snapshot(store_or_dir, quiet_rounds: int = 2) -> tuple[int, dict]:
                 m["key"], {m["repro"].get("worker_id", 0)})),
             recipe=fam,
             op=opn,
+            origin=ogn,
             repro={k: int(v) for k, v in m["repro"].items()},
             minimized=bool("minimized" in m),
             chain_complete=((not ct) if ct is not None
@@ -417,6 +435,8 @@ def triage_snapshot(store_or_dir, quiet_rounds: int = 2) -> tuple[int, dict]:
             recipe_buckets=recipe_bk,
             operator_coverage=op_cov,
             operator_buckets=op_bk,
+            origin_coverage=origin_cov,
+            origin_buckets=origin_bk,
             rows_known=rows is not None),
         curves=dict(coverage=_downsample(tl["coverage_curve"]),
                     rate=_downsample(tl["rate_curve"]),
@@ -513,7 +533,8 @@ def triage_diff(prev: dict, cur: dict,
             added=len(c_keys - p_keys), removed=len(p_keys - c_keys)),
         attribution={dim: _delta_map(pa.get(dim, {}), ca.get(dim, {}))
                      for dim in ("recipe_coverage", "recipe_buckets",
-                                 "operator_coverage", "operator_buckets")},
+                                 "operator_coverage", "operator_buckets",
+                                 "origin_coverage", "origin_buckets")},
         p99=_delta_map(dict(brief=prev.get("p99")),
                        dict(brief=cur.get("p99"))),
         workers=_delta_map(prev.get("workers_health", {}),

@@ -117,6 +117,13 @@ case "$tier" in
     # divergence microscope must name the same first divergent
     # dispatch on a re-run of the same lane pair
     python bench.py --tt-smoke
+    # lineage-driven-fault-injection smoke: green-support extraction on
+    # a seeded rpc_echo lane must match an inline host parent-walk
+    # reference, every synthesized targeted vector must stay on the
+    # knob plane (time-guarded rows only, pool-confined targets,
+    # in-bounds values), and one targeted round must replay
+    # bit-identically from its (seed, knobs) handle
+    python bench.py --ldfi-smoke
     # regression gate (OSS-Fuzz-style): every committed crash bucket in
     # tests/data/regression_corpus must still reproduce (run-twice
     # verified) and the top-energy corpus slice must still land on its
