@@ -439,7 +439,8 @@ def _sched_ab_mode():
 
 
 def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0,
-                        profile=False, latency_hist=0, series_windows=0):
+                        profile=False, latency_hist=0, series_windows=0,
+                        span_attr=False):
     """A deliberately tiny workload (2-node ping-pong, C=16, P=2, stats
     off) for the fused A/B: per-step device compute is small, so the
     per-chunk host round-trip the chunked runner pays
@@ -457,6 +458,9 @@ def _make_light_runtime(n_nodes=2, loss=0.0, trace_cap=0, sketch_slots=0,
                     trace_cap=trace_cap, sketch_slots=sketch_slots,
                     profile=profile, latency_hist=latency_hist,
                     series_windows=series_windows,
+                    # span_attr rides the latency plane's complete_kinds
+                    # below — callers pass latency_hist>0 alongside it
+                    span_attr=span_attr,
                     # ping deliveries as completions so the lat_ab
                     # variants pay the e2e fold, not just the sojourn
                     complete_kinds=(((EV_MSG, 1),) if latency_hist
@@ -3923,6 +3927,249 @@ def _tt_ab_mode():
     print(json.dumps(out))
 
 
+def _span_ab_mode():
+    """--mode span_ab: attribution-plane overhead A/B on the fused
+    runner — the series_ab protocol exactly (worst-case tiny step,
+    interleaved min-of-9 reps). Three builds, identical trajectories by
+    construction (the span carry and tail folds consume no randomness):
+
+      off          span_attr=False — plane compiled out; latency plane
+                   on in every variant so the delta is the SPAN cost,
+                   not span+latency
+      span_masked  span_attr=True compiled in, NO lanes attributing —
+                   the cost of carrying ev_span through the pop/dispatch
+                   path and the masked tail folds; the ship-with-it
+                   shape, bar <= 3% at B=512
+      span_on      every lane attributes (the ceiling)
+
+    Writes BENCH_span_ab_<platform>.json next to this file."""
+    _preflight_or_cpu("--span-ab")
+    import jax
+    platform = jax.devices()[0].platform
+    B, steps, chunk, reps = 512, 2048, 256, 9
+    variants = (("off", False, None), ("span_masked", True, []),
+                ("span_on", True, None))
+    out = {"metric": "span_ab", "platform": platform, "batch": B,
+           "steps": steps, "chunk": chunk, "reps": reps,
+           "note": ("tiny 2-node workload = worst case for relative "
+                    "span-plane overhead (fixed per-step ev_span carry "
+                    "+ fold vs tiny step); latency plane ON in all "
+                    "three builds so the delta isolates span_attr; "
+                    "fused runner, lanes never halt, identical step "
+                    "counts per variant; reps interleaved round-robin, "
+                    "min-of-reps. span_masked and span_on execute "
+                    "identical compute (masked folds run either way) — "
+                    "spread between them is the noise floor. Bar: "
+                    "span_masked <= 3% MODULO this host's cross-run "
+                    "envelope (the causal_ab/lat_ab caveat, DESIGN "
+                    "§12); read overhead_span_program (pooled best "
+                    "over the identical-compute builds)"),
+           "variants": {}}
+    seeds = np.arange(B)
+    by_sp = {sp: _make_light_runtime(latency_hist=24, span_attr=sp)
+             for sp in {sp for _, sp, _ in variants}}
+    rts, kws = {}, {}
+    for name, sp, lanes in variants:
+        rts[name] = by_sp[sp]
+        kws[name] = ({} if not sp or lanes is None
+                     else {"span_lanes": lanes})
+    for rt in by_sp.values():
+        jax.block_until_ready(
+            rt.run_fused(rt.init_batch(seeds), steps, chunk).now)
+    best = {name: float("inf") for name, _, _ in variants}
+    for _ in range(reps):
+        for name, _, _ in variants:
+            state = rts[name].init_batch(seeds, **kws[name])
+            jax.block_until_ready(state.now)
+            t0 = time.perf_counter()
+            final = rts[name].run_fused(state, steps, chunk)
+            jax.block_until_ready(final.now)
+            best[name] = min(best[name], time.perf_counter() - t0)
+    eps = {name: B * steps / b for name, b in best.items()}
+    for name, _, _ in variants:
+        out["variants"][name] = round(eps[name], 1)
+        print(f"--span-ab: {name} {eps[name]:,.0f} seed-events/s",
+              file=sys.stderr)
+    for name in ("span_masked", "span_on"):
+        out[f"overhead_{name}"] = round(eps["off"] / eps[name] - 1, 4)
+    # span_masked and span_on run the SAME executable on different
+    # sp_on values (masked folds execute either way), so their pooled
+    # best is the honest program cost vs off — the causal_ab precedent
+    # (DESIGN §12) for hosts whose per-variant spread exceeds the bar
+    pooled = max(eps["span_masked"], eps["span_on"])
+    out["overhead_span_program"] = round(eps["off"] / pooled - 1, 4)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BENCH_span_ab_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(dict(out, measured_at=time.strftime("%F %T")), f,
+                  indent=1)
+    print(json.dumps(out))
+
+
+def _span_smoke_mode():
+    """--span-smoke: seconds-scale attribution-plane self-test for CI
+    (wired into scripts/ci.sh fast):
+
+      1. on a chaos rpc_echo workload (kill/restart mid-run, re-mint
+         roots) the device's per-(lane, node) sa_tail counters must
+         EQUAL a host parent-walk of the flight-recorder ring on every
+         component — tail count vs lh_slo_miss, queue-wait, net, hops —
+         and every tail completion must name exactly one bottleneck
+         node (sa_bottleneck);
+      2. the plane must be free of trajectory influence: fingerprints
+         equal across span-on/compiled-out, fused == chunked on every
+         trace column;
+      3. on a pause/resume pingpong (parked deadlines -> NONZERO
+         queue-wait, the component chaos-free EDF never exercises) the
+         obs.request_spans decomposition must telescope exactly
+         (wait + transit == e2e per chain) and its tail totals and
+         dominant-node fold must match sa_tail / sa_bottleneck;
+      4. explain_latency must name the lane's slowest request
+         identically on re-run, and the Perfetto export must carry the
+         ph="b"/"e" request duration spans exactly when span_attr is on.
+
+    Forced to CPU so a dead TPU tunnel cannot stall CI."""
+    _force_cpu_inprocess()
+    import json as _json
+    import tempfile
+    from madsim_tpu import (NetConfig, Runtime, Scenario, SimConfig, ms,
+                            sec)
+    from madsim_tpu.core.state import TRACE_FIELDS
+    from madsim_tpu.core.types import EV_MSG
+    from madsim_tpu.models.pingpong import PingPong, state_spec
+    from madsim_tpu.models.rpc_echo import TAG_ECHO, make_echo_runtime
+    from madsim_tpu.net import rpc
+    from madsim_tpu.obs import (explain_latency, export_profile_trace,
+                                format_span, request_spans, ring_records)
+    t0 = time.perf_counter()
+    rtag = rpc.reply_tag(TAG_ECHO)
+    SLO = ms(8)
+    seeds = np.arange(8, dtype=np.uint32)
+
+    def make_echo(span):
+        sc = Scenario()
+        sc.at(ms(300)).kill(0)
+        sc.at(ms(420)).restart(0)
+        cfg = SimConfig(
+            n_nodes=4, event_capacity=64, time_limit=sec(5),
+            latency_hist=24, trace_cap=512,
+            complete_kinds=((EV_MSG, rtag),),
+            root_kinds=((EV_MSG, rtag),),
+            slo_target=SLO, span_attr=span,
+            net=NetConfig(send_latency_min=ms(1), send_latency_max=ms(8)))
+        return make_echo_runtime(n_nodes=4, target=8, scenario=sc,
+                                 cfg=cfg)
+
+    # 1+2: device fold == host parent-walk; bit-identity
+    rt_on, rt_off = make_echo(True), make_echo(False)
+    on, _ = rt_on.run(rt_on.init_batch(seeds), 2048, 256)
+    off, _ = rt_off.run(rt_off.init_batch(seeds), 2048, 256)
+    fused = rt_on.run_fused(rt_on.init_batch(seeds), 2048, 256)
+    assert (rt_on.fingerprints(on) == rt_off.fingerprints(off)).all(), \
+        "span plane perturbed the trajectory"
+    assert (rt_on.fingerprints(on) == rt_on.fingerprints(fused)).all()
+    for f in TRACE_FIELDS:
+        assert (np.asarray(getattr(on, f))
+                == np.asarray(getattr(fused, f))).all(), f
+    sa = np.asarray(on.sa_tail)
+    sb = np.asarray(on.sa_bottleneck)
+    assert (sa[:, :, 0] == np.asarray(on.lh_slo_miss)).all(), \
+        "tail count must equal lh_slo_miss per node"
+    assert sb.sum() == sa[:, :, 0].sum(), \
+        "every tail completion names one dominant node"
+    walked = 0
+    for b in range(len(seeds)):
+        recs = ring_records(on, b)
+        assert recs["dropped"] == 0, "ring must hold the whole history"
+        lat = np.asarray(recs["lat"])
+        qw = np.asarray(recs["qw"])
+        step_at = {int(s): i for i, s in enumerate(recs["step"])}
+        hq = hn = hh = 0
+        for i in np.nonzero(lat >= 0)[0]:
+            if lat[i] <= SLO:
+                continue            # only tails attribute
+            # parent-walk to the root (reply deliveries are root_kinds):
+            # sum each hop's queue-wait, count hops; the remainder of
+            # e2e is transit — the telescoping identity. An externally
+            # minted element IS the root (core/step.py root rule): its
+            # own wait belongs to no request, so it is not counted.
+            j, q, hops = int(i), 0, 0
+            while True:
+                p = int(recs["parent"][j])
+                if p < 0 or p not in step_at:
+                    break           # j is the external root
+                q += int(qw[j])
+                hops += 1
+                jp = step_at[p]
+                if (int(recs["kind"][jp]) == EV_MSG
+                        and int(recs["tag"][jp]) == rtag):
+                    break           # completion -> root re-mint
+                j = jp
+            hq += q
+            hn += int(lat[i]) - q
+            hh += hops
+            walked += 1
+        assert (hq, hn, hh) == (sa[b, :, 1].sum(), sa[b, :, 2].sum(),
+                                sa[b, :, 3].sum()), b
+    tails = int(sa[:, :, 0].sum())
+    assert walked == tails > 0
+
+    # 3: nonzero queue-wait + host span decomposition vs device
+    sc = Scenario()
+    sc.at(ms(30)).pause(1)
+    sc.at(ms(90)).resume(1)
+    cfg = SimConfig(n_nodes=3, time_limit=sec(5), latency_hist=24,
+                    trace_cap=1024, complete_kinds=((EV_MSG, 1),),
+                    slo_target=ms(6), span_attr=True,
+                    net=NetConfig(send_latency_min=ms(1),
+                                  send_latency_max=ms(4)))
+    rt_pp = Runtime(cfg, [PingPong(3, target=40)], state_spec(),
+                    scenario=sc)
+    pp, _ = rt_pp.run(rt_pp.init_batch(seeds), 400, 100)
+    sa_pp = np.asarray(pp.sa_tail)
+    assert sa_pp[:, :, 1].sum() > 0, \
+        "pause/resume must produce nonzero queue-wait"
+    for b in range(len(seeds)):
+        spans = request_spans(pp, b, slo_target=ms(6))
+        for sp in spans:
+            if not sp["truncated"]:
+                assert (sp["wait_us"] + sp["transit_us"]
+                        == sp["lat_us"]), sp
+        tl = [sp for sp in spans if sp["tail"] and not sp["truncated"]]
+        assert sum(sp["wait_us"] for sp in tl) == sa_pp[b, :, 1].sum()
+        assert sum(sp["transit_us"] for sp in tl) == sa_pp[b, :, 2].sum()
+        assert sum(len(sp["hops"]) for sp in tl) == sa_pp[b, :, 3].sum()
+        bn = np.zeros(3, np.int64)
+        for sp in tl:
+            bn[sp["dominant"]["node"]] += 1
+        assert (bn == np.asarray(pp.sa_bottleneck)[b]).all(), b
+
+    # 4: deterministic explain + Perfetto request spans iff span_attr
+    e1 = explain_latency(pp, 2, rt=rt_pp)
+    e2 = explain_latency(pp, 2, rt=rt_pp)
+    assert e1 == e2, "explain_latency must be deterministic on re-run"
+    lat2 = np.asarray(ring_records(pp, 2)["lat"])
+    assert e1["lat_us"] == int(lat2[lat2 >= 0].max())
+    assert format_span(e1)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "span.json")
+        export_profile_trace(p, pp, lane=2)
+        with open(p) as f:
+            phs = {e.get("ph") for e in _json.load(f)["traceEvents"]}
+        assert {"b", "e"} <= phs, phs
+        export_profile_trace(p, off, lane=0)
+        with open(p) as f:
+            phs_off = {e.get("ph") for e in _json.load(f)["traceEvents"]}
+        assert "b" not in phs_off, "span-off export must not grow spans"
+    print(_json.dumps({
+        "metric": "span_smoke", "platform": "cpu", "ok": True,
+        "lanes_checked": int(len(seeds)), "tails": tails,
+        "parent_walks_checked": walked,
+        "qwait_us": int(sa_pp[:, :, 1].sum()),
+        "bottleneck_by_node": sb.sum(0).tolist(),
+        "wall_s": round(time.perf_counter() - t0, 1)}))
+
+
 def main():
     # `--mode X` is accepted as an alias for `--X` (dashes for
     # underscores), so `bench.py --mode fused_ab` and `bench.py
@@ -3943,7 +4190,8 @@ def main():
                  "--campaign-smoke", "--analyze-smoke", "--detsan-ab",
                  "--shard", "--shard-smoke", "--prof-ab", "--prof-smoke",
                  "--lat-ab", "--lat-smoke", "--series-ab",
-                 "--series-smoke", "--grayfail-smoke",
+                 "--series-smoke", "--span-ab", "--span-smoke",
+                 "--grayfail-smoke",
                  "--regression-smoke", "--triage-smoke", "--conn-smoke",
                  "--tt-ab", "--tt-smoke", "--ldfi-ab", "--ldfi-smoke"}
         if flag not in known:
@@ -3982,6 +4230,12 @@ def main():
         return
     if "--prof-smoke" in sys.argv:
         _prof_smoke_mode()
+        return
+    if "--span-ab" in sys.argv:
+        _span_ab_mode()
+        return
+    if "--span-smoke" in sys.argv:
+        _span_smoke_mode()
         return
     if "--series-ab" in sys.argv:
         _series_ab_mode()

@@ -39,16 +39,19 @@ from .obs import (
     SweepObserver,
     divergence_report,
     explain_crash,
+    explain_latency,
     export_chrome_trace,
     export_profile_trace,
     format_latency,
     format_profile,
     format_series,
+    format_span,
     full_chain_replay,
     lane_series,
     latency_summary,
     profile_summary,
     replay_window,
+    request_spans,
     ring_records,
     series_summary,
 )
@@ -89,6 +92,7 @@ __all__ = [
     "profile_summary", "format_profile", "export_profile_trace",
     "latency_summary", "format_latency",
     "series_summary", "format_series", "lane_series",
+    "explain_latency", "format_span", "request_spans",
     "CorpusStore", "run_campaign", "supervise_campaign", "campaign_report",
     "merged_buckets", "replay_bucket",
     "triage_snapshot", "triage_diff", "audit_buckets",

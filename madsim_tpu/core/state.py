@@ -48,7 +48,7 @@ from . import types as T
 # (DESIGN §12).
 TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
                 "tr_kind", "tr_node", "tr_src", "tr_tag",
-                "tr_parent", "tr_lamport", "tr_qlen", "tr_lat",
+                "tr_parent", "tr_lamport", "tr_qlen", "tr_lat", "tr_qw",
                 "ev_prov", "lamport",
                 "cov_sketch", "sketch_every",
                 "pf_on", "pf_dispatch", "pf_busy", "pf_kill", "pf_restart",
@@ -58,6 +58,7 @@ TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
                 "sr_on", "window_len", "sr_dispatch", "sr_busy", "sr_qhw",
                 "sr_drop", "sr_dup", "sr_complete", "sr_slo_miss",
                 "sr_lat", "sr_fault",
+                "sp_on", "ev_span", "sa_tail", "sa_bottleneck",
                 "hash_base")
 # hash_base rides TRACE_FIELDS for the fingerprint-exclusion contract
 # only: it is a CONSTANT pure function of the lane's seed (never
@@ -72,6 +73,26 @@ TRACE_FIELDS = ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
 # valid dispatches count, and a valid dispatch is never EV_FREE).
 # Derived from the enum so a new kind widens the counter automatically
 N_EV_KINDS = T.EV_SUPER + 1
+
+# ev_span's word axis (the r23 critical-path attribution plane): the
+# per-row carried span vector, broadcast to every emission of a dispatch
+# exactly like the ev_prov provenance pair. All words describe the
+# row's CHAIN as of its enqueue; the dispatch that pops the row folds in
+# its own queue-wait and incoming-edge transit before re-broadcasting.
+SP_QWAIT = 0     # accumulated queue-wait ticks since the chain's root
+SP_NET = 1       # accumulated network/disk transit ticks since the root
+SP_HOPS = 2      # hop index: dispatches since the root (root row = 0)
+SP_DOM_NODE = 3  # node owning the DOMINANT segment so far (-1 = none)
+SP_DOM_MAG = 4   # that segment's magnitude (transit + wait ticks)
+SP_EMIT_T = 5    # the emitting dispatch's virtual time (-1 = external)
+SPAN_WORDS = 6
+
+# sa_tail's component axis: per-completion-node tail attribution
+SA_COUNT = 0     # tail completions (e2e > slo_target) at this node
+SA_QWAIT = 1     # their accumulated queue-wait ticks
+SA_NET = 2       # their accumulated network/disk transit ticks
+SA_HOPS = 3      # their accumulated hop counts
+SA_COMPONENTS = 4
 
 
 @struct.dataclass
@@ -396,6 +417,41 @@ class SimState:
                             # never saturates) — the recovery oracle's
                             # "last disturbed window" axis
 
+    # --- critical-path attribution plane (cfg.span_attr; obs/spans.py) ----
+    # WHERE the tail comes from (DESIGN §24): every pending row carries
+    # its chain's accumulated span vector (the ev_prov/ev_root_t
+    # broadcast-select, carrying SPAN_WORDS words instead of one), and a
+    # completion over the dynamic slo_target folds it into per-node
+    # tail-attribution counters through the one-hot machinery.
+    # Observation only (TRACE_FIELDS): no randomness, no non-span state,
+    # excluded from fingerprints; zero-size when compiled out
+    # (cfg.span_attr=False). Counters SATURATE at int32 max (§16).
+    sp_on: jax.Array        # bool — lane gate (init_batch(span_lanes=))
+    ev_span: jax.Array      # int32[C, SPAN_WORDS] — per pending row: the
+                            # chain's accumulated queue-wait / transit /
+                            # hops, dominant (node, magnitude), and the
+                            # emitting dispatch's virtual time (see the
+                            # SP_* word index above); external rows are
+                            # [0, 0, 0, -1, 0, -1]
+    sa_tail: jax.Array      # int32[N, SA_COMPONENTS] — per COMPLETION
+                            # node: count / queue-wait / transit / hops
+                            # of tail completions (e2e > slo_target);
+                            # queue + transit of a completion sum to its
+                            # e2e latency exactly (the telescoping rule,
+                            # DESIGN §24) — the invariant the host
+                            # parent-walk cross-check holds device-vs-ring
+    sa_bottleneck: jax.Array  # int32[N] — how often node n owned a tail
+                            # completion's DOMINANT segment (largest
+                            # wait+transit hop) — the bottleneck histogram
+    tr_qw: jax.Array        # int32[bucket] — the dispatch's OWN
+                            # queue-wait (now − the popped row's
+                            # deadline): the ring column that lets a host
+                            # parent-walk split every hop into wait vs
+                            # transit (obs/spans.py). Compiled in only
+                            # when BOTH the ring and the span plane are
+                            # (cfg.trace_cap > 0 and cfg.span_attr);
+                            # same skip contract as tr_qlen/tr_lat
+
     # --- extension state (plugin framework analog, plugin.rs) -------------
     ext: Any                # dict: extension name -> its state subtree
 
@@ -517,6 +573,17 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
         sr_lat=jnp.zeros((cfg.series_windows if cfg.latency_hist > 0
                           else 0, cfg.latency_hist), i32),
         sr_fault=jnp.zeros((cfg.series_windows,), i32),
+        # span-attribution default: every lane attributes (when compiled
+        # in); init_batch(span_lanes=...) narrows. Rows start external
+        # ([0,0,0,-1,0,-1] — nothing accumulated, no dominant segment,
+        # no emitter); tr_qw needs BOTH gates, like tr_qlen/tr_lat.
+        sp_on=jnp.asarray(cfg.span_attr),
+        ev_span=jnp.tile(jnp.asarray([[0, 0, 0, -1, 0, -1]], i32),
+                         (C if cfg.span_attr else 0, 1)),
+        sa_tail=jnp.zeros((N if cfg.span_attr else 0, SA_COMPONENTS), i32),
+        sa_bottleneck=jnp.zeros((N if cfg.span_attr else 0,), i32),
+        tr_qw=jnp.zeros((cfg.trace_cap_bucket if cfg.span_attr else 0,),
+                        i32),
         ext=ext_state if ext_state is not None else {},
     )
 
@@ -547,7 +614,7 @@ def init_state(cfg: T.SimConfig, key: jax.Array, node_state: Any,
 _CKPT_PLANES = {
     "ring": ("trace_on", "trace_pos", "trace_cap", "tr_now", "tr_step",
              "tr_kind", "tr_node", "tr_src", "tr_tag", "tr_parent",
-             "tr_lamport", "tr_qlen", "tr_lat"),
+             "tr_lamport", "tr_qlen", "tr_lat", "tr_qw"),
     "lineage": ("ev_prov", "lamport"),
     "sketch": ("cov_sketch", "sketch_every"),
     "profile": ("pf_on", "pf_dispatch", "pf_busy", "pf_kill", "pf_restart",
@@ -557,6 +624,7 @@ _CKPT_PLANES = {
     "series": ("sr_on", "window_len", "sr_dispatch", "sr_busy", "sr_qhw",
                "sr_drop", "sr_dup", "sr_complete", "sr_slo_miss",
                "sr_lat", "sr_fault"),
+    "span": ("sp_on", "ev_span", "sa_tail", "sa_bottleneck"),
 }
 
 # the WORLD slice of a structural signature: the fields two runtimes
@@ -567,12 +635,12 @@ _CKPT_PLANES = {
 # distinct replay domain). The OBSERVABILITY fields (trace bucket,
 # sketch_slots, profile, latency_hist, complete/root kinds) and the
 # emission_write lowering are deliberately excluded: differing there is
-# the point of window replay. Indexes into the simconfig-v7 tuple
-# (types.SimConfig.structural_signature — v7 appended series_windows at
-# the END, so these indices still name the same world fields); the
-# version string at [0] keeps the indexing honest across future
-# signature revisions, and a pre-r21 (v6) checkpoint/store rejects on
-# it automatically.
+# the point of window replay. Indexes into the simconfig-v8 tuple
+# (types.SimConfig.structural_signature — v7/v8 appended
+# series_windows/span_attr at the END, so these indices still name the
+# same world fields); the version string at [0] keeps the indexing
+# honest across future signature revisions, and a pre-r23 (v7)
+# checkpoint/store rejects on it automatically.
 _SIG_WORLD_IDX = (0, 1, 2, 3, 4, 6, 9)
 
 _LANE_CKPT_FORMAT = "madsim-lane-ckpt-r20"

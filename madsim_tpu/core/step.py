@@ -32,6 +32,7 @@ from ..ops import select as sel
 from . import prng
 from . import types as T
 from .api import Ctx, Program
+from . import state as ST
 from .state import N_EV_KINDS, SimState
 
 
@@ -281,6 +282,30 @@ def make_step(
             # the root this dispatch's EMISSIONS inherit (post-mint)
             ev_root = jnp.where(inherit, root_raw, now)
             lat_sojourn = jnp.maximum(jnp.where(valid, now - dmin, 0), 0)
+        if cfg.span_attr:
+            # ---- span-attribution carried reads (r23; DESIGN §24) --------
+            # Pre-pop like ev_root_t (the popped slot may be reclaimed by
+            # this dispatch's own emissions). The carried vector follows
+            # the root's inherit/measure split: the completion fold
+            # measures the INHERITED chain (pre-re-mint), emissions carry
+            # the post-mint one. A row minting its root starts a fresh
+            # chain — nothing accumulated, no dominant segment. The
+            # incoming edge's transit is recoverable at dispatch with no
+            # per-emission storage: deadline − the emitter's stamped
+            # `now` (SP_EMIT_T) = the latency + disk delay the emission
+            # imposed (a dup re-arm moves the deadline, so the duplicate
+            # delivery honestly measures to ITS deadline). Pure selects,
+            # no randomness.
+            inherit_sp = valid & (root_raw >= 0)
+            span_raw = sel.take_row(s.ev_span, idx)        # [SPAN_WORDS]
+            in_sq = jnp.where(inherit_sp, span_raw[ST.SP_QWAIT], 0)
+            in_sn = jnp.where(inherit_sp, span_raw[ST.SP_NET], 0)
+            in_sh = jnp.where(inherit_sp, span_raw[ST.SP_HOPS], 0)
+            in_dnode = jnp.where(inherit_sp, span_raw[ST.SP_DOM_NODE], -1)
+            in_dmag = jnp.where(inherit_sp, span_raw[ST.SP_DOM_MAG], 0)
+            in_emit = jnp.where(inherit_sp, span_raw[ST.SP_EMIT_T], -1)
+            net_seg = jnp.where(inherit_sp & (in_emit >= 0),
+                                jnp.maximum(dmin - in_emit, 0), 0)
         # strict >: the scenario's HALT op sits at exactly time_limit, and
         # same-deadline ties may dispatch before it without being late
         time_over = now > s.tlimit
@@ -338,6 +363,39 @@ def make_step(
                                      prov[1]) + 1
             s = s.replace(lamport=sel.put_row(s.lamport, lam_node,
                                               ev_lamport, valid))
+
+        # ---- span-attribution accumulation (cfg.span_attr; DESIGN §24) ---
+        # Fold THIS dispatch's hop into the chain it inherited: its own
+        # queue-wait (lat_sojourn) into the wait accumulator, the
+        # incoming edge's transit (net_seg) into the transit accumulator,
+        # and the hop's total cost against the dominant segment, owned by
+        # the ACTING node (the pf_busy attribution rule). The measured
+        # accumulators telescope: wait + transit of a completion equals
+        # now − root EXACTLY (every hop contributes (deadline − emit) +
+        # (dispatch − deadline) = dispatch − emit, and emit stamps chain
+        # from the root's own `now`) — the invariant the host parent-walk
+        # cross-check and the sa_tail fold both stand on. A dispatch
+        # minting a fresh root measures zero (it IS the root).
+        if cfg.span_attr:
+            act_sp = jnp.where(is_super, reset_target, ev_node)
+            meas_sq = jnp.where(inherit_sp, in_sq + lat_sojourn, 0)
+            meas_sn = jnp.where(inherit_sp, in_sn + net_seg, 0)
+            meas_sh = in_sh
+            seg_sp = net_seg + lat_sojourn          # this hop's cost
+            dom_up = inherit_sp & (seg_sp > in_dmag)
+            meas_dnode = jnp.where(dom_up, act_sp, in_dnode)
+            meas_dmag = jnp.where(dom_up, seg_sp, in_dmag)
+            # what this dispatch's EMISSIONS carry (post-mint, like
+            # ev_root): a re-minted root restarts the chain at zero; the
+            # child's hop index is this dispatch's plus one; every
+            # emission is stamped with this dispatch's `now`
+            span_new = jnp.stack([
+                jnp.where(inherit, meas_sq, 0),
+                jnp.where(inherit, meas_sn, 0),
+                jnp.where(inherit, meas_sh, 0) + 1,
+                jnp.where(inherit, meas_dnode, -1),
+                jnp.where(inherit, meas_dmag, 0),
+                now])                               # [SPAN_WORDS]
 
         # ---- 3. protocol handler dispatch ---------------------------------
         node_ok = (sel.take1(s.alive, ev_node)
@@ -580,6 +638,19 @@ def make_step(
                 else:
                     s = s.replace(ev_root_t=jnp.where(
                         written, ev_root, s.ev_root_t))
+            if cfg.span_attr:
+                # carried span vector: every row this dispatch emits
+                # inherits the chain THROUGH this dispatch (its own
+                # queue-wait and incoming transit folded in above) — one
+                # [SPAN_WORDS] broadcast per dispatch riding the same
+                # slots_eff / written machinery as ev_prov/ev_root_t
+                if em_scatter:
+                    s = s.replace(ev_span=s.ev_span.at[slots_eff].set(
+                        jnp.broadcast_to(span_new, (E, ST.SPAN_WORDS)),
+                        mode="drop", unique_indices=True))
+                else:
+                    s = s.replace(ev_span=jnp.where(
+                        written[:, None], span_new[None, :], s.ev_span))
 
         # oops/steps are correctness-bearing and always tracked; the stat
         # counters honor cfg.collect_stats (Stat is optional in the
@@ -695,6 +766,30 @@ def make_step(
                 # completions record e2e, everything else -1
                 lat_e2e = jnp.where(is_complete, lat_e2e,
                                     jnp.asarray(-1, jnp.int32))
+
+        # ---- span-attribution fold (cfg.span_attr; DESIGN §24) -----------
+        # Only TAIL completions attribute (e2e over the dynamic
+        # slo_target — the lh_slo_miss gate, on this plane's own lane
+        # mask): the healthy majority would drown the tail's signal.
+        # One [N, SA_COMPONENTS] saturating masked add at the completion
+        # node plus one [N] one-hot increment at the dominant segment's
+        # owner. No randomness, no non-span state — the pf_*/lh_*
+        # transparency contract.
+        if cfg.span_attr:
+            tail_sp = (is_complete & s.sp_on & (s.slo_target > 0)
+                       & (lat_e2e_raw > s.slo_target))
+            comp_vals = jnp.stack([jnp.asarray(1, jnp.int32), meas_sq,
+                                   meas_sn, meas_sh])  # [SA_COMPONENTS]
+            oh_dom = (sel.row_onehot(
+                cfg.n_nodes, jnp.clip(meas_dnode, 0, cfg.n_nodes - 1))
+                & tail_sp & (meas_dnode >= 0))
+            s = s.replace(
+                sa_tail=_sat_add(
+                    s.sa_tail,
+                    jnp.where(oh_cpl[:, None] & tail_sp,
+                              comp_vals[None, :], 0)),
+                sa_bottleneck=_sat_add(s.sa_bottleneck,
+                                       oh_dom.astype(jnp.int32)))
 
         # ---- prefix-coverage sketch (cfg.sketch_slots; DESIGN §12) -------
         # Fold the running sched_hash into slot j = steps/every - 1 at
@@ -879,6 +974,11 @@ def make_step(
                     s.tr_lat,
                     lat_e2e if lat_e2e is not None
                     else jnp.asarray(-1, jnp.int32))
+            if cfg.span_attr:
+                # queue-wait ring column: the dispatch's own sojourn, so
+                # a host parent-walk splits every hop into wait vs
+                # transit (obs/spans.py explain_latency)
+                extra_cols["tr_qw"] = ringput(s.tr_qw, lat_sojourn)
             s = s.replace(
                 **extra_cols,
                 tr_now=ringput(s.tr_now, record["now"]),

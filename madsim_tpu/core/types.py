@@ -449,6 +449,34 @@ class SimConfig:
     # initial SimState.window_len in ticks per window (DYNAMIC knob,
     # like slo_target/sketch_every; default 1 simulated second)
     window_len: int = TICKS_PER_SEC
+    # critical-path attribution plane (obs/spans.py, DESIGN §24): False
+    # (default) compiles the plane out entirely — zero-size columns, no
+    # span code in the step. True adds, per lane, carried span columns
+    # riding the r10/r16 provenance broadcast-select (every pending row
+    # carries its chain's accumulated queue-wait ticks, accumulated
+    # network/disk-delay ticks, hop count, the dominant segment's
+    # (node, magnitude), and the emitting dispatch's virtual time), and
+    # at complete_kinds dispatches folds them through the one-hot
+    # machinery into saturating tail-attribution counters:
+    #   sa_tail       [N, 4]  per completion node: tail-request count,
+    #                         queue-wait ticks, network/disk ticks, hops
+    #                         — accumulated ONLY for completions over the
+    #                         dynamic SimState.slo_target (tail requests
+    #                         attribute; the healthy majority stays out);
+    #   sa_bottleneck [N]     how often node n owned a tail request's
+    #                         DOMINANT segment (largest queue+transit
+    #                         hop) — the bottleneck-node histogram.
+    # With trace_cap > 0 the ring also grows a `tr_qw` column (the
+    # dispatch's own queue-wait), so a host parent-walk can split every
+    # hop into wait vs transit (obs/spans.py `explain_latency`). Like
+    # trace_cap, an observation lever, not a replay domain: the writes
+    # consume no randomness and touch no non-span state, trajectories
+    # are BIT-IDENTICAL across settings, and the ev_span/sa_* columns
+    # ride TRACE_FIELDS out of fingerprints. Per-lane masking rides
+    # `init_batch(span_lanes=...)`. Requires the latency plane
+    # (latency_hist > 0) and complete_kinds — attribution is a property
+    # of measured completions.
+    span_attr: bool = False
     # emission-write lowering: how staged emissions land in the event
     # table. "onehot" = [E, C] one-hot masked-sum (VPU-friendly — the TPU
     # default); "scatter" = one XLA scatter per column at distinct slot
@@ -493,6 +521,11 @@ class SimConfig:
             assert self.latency_hist > 0, \
                 "complete_kinds/root_kinds/slo_target need the latency " \
                 "plane compiled in (latency_hist > 0)"
+        assert isinstance(self.span_attr, bool)
+        if self.span_attr:
+            assert self.latency_hist > 0 and self.complete_kinds, \
+                "span_attr attributes measured completions: it needs " \
+                "the latency plane (latency_hist > 0) AND complete_kinds"
         assert self.sketch_every >= 1
         assert self.table_dtype in ("int32", "int16")
         assert self.emission_write in ("auto", "onehot", "scatter")
@@ -517,7 +550,7 @@ class SimConfig:
         ride as operands. `emission_write` stays raw here — 'auto'
         resolves per backend at trace time, and the cache keys the
         backend separately."""
-        return ("simconfig-v7", self.n_nodes, self.event_capacity,
+        return ("simconfig-v8", self.n_nodes, self.event_capacity,
                 self.payload_words, self.table_dtype, self.emission_write,
                 bool(self.collect_stats), self.trace_cap_bucket,
                 self.sketch_slots, self.net.op_jitter_max > 0,
@@ -526,7 +559,10 @@ class SimConfig:
                 # v7 (r21): the windowed-telemetry plane's window COUNT —
                 # appended at the END so the _SIG_WORLD_IDX world-slice
                 # indices (core/state.py) keep naming the same fields
-                self.series_windows)
+                self.series_windows,
+                # v8 (r23): the critical-path attribution plane's gate —
+                # appended at the END, same rationale
+                bool(self.span_attr))
 
     def hash(self) -> str:
         """Stable 8-hex-digit config hash, printed on test failure so a repro

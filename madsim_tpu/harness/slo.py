@@ -21,6 +21,15 @@ Determinism: the p99 estimate is the bucket-CDF lower bound
 (parallel/stats quantile rule — exact integer bucketing, exact integer
 CDF), so the check is a pure function of the lane's dispatch history
 and fires on the SAME dispatch in every replay.
+
+When an SLO lane needs a diagnosis, not just a verdict: build the
+runtime with `SimConfig(span_attr=True)` and point
+`obs.explain_latency(state, lane, rt=rt)` at the crashed lane — it
+names the slowest request's hop-by-hop critical path (queue-wait vs
+transit per hop, the dominant segment's node) off the same ring the
+repro replays, and `parallel.stats.attribution_brief` /
+`summarize()["attribution"]` aggregate the tail's time split
+fleet-wide (DESIGN §24).
 """
 
 from __future__ import annotations

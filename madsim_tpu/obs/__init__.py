@@ -64,6 +64,14 @@ that contract at three altitudes, each with a deliberate host-boundary cost
                   microscope (`divergence_report` names two lanes'
                   first divergent dispatch by replaying from their
                   last common checkpoint under full tracing).
+  * spans.py    — (r23) the WHERE-DID-THE-TIME-GO layer: decompose a
+                  completion's causal chain into per-hop (queue-wait,
+                  transit) segments off the `span_attr` ring columns —
+                  segments telescope to the recorded e2e latency
+                  exactly — and `explain_latency` names the slowest
+                  request's hop-by-hop critical path (replay=True
+                  recovers wrap-truncated chains via r20 window
+                  replay, same playbook as explain_crash).
   * support.py  — (r22) the WHY-IT-WORKED layer: walk the same lineage
                   columns BACKWARD from a success witness in a GREEN
                   lane to the support of its success — the message and
@@ -79,15 +87,17 @@ from .dashboard import render_html, sparkline_svg
 from .metrics import JsonlObserver, SweepObserver, TeeObserver
 from .timetravel import (CheckpointLog, ReplayDivergence, divergence_report,
                          full_chain_replay, replay_window)
-from .profiler import (counter_track_events, curve_brief,
-                       export_profile_trace,
-                       format_latency, format_profile,
+from .profiler import (attribution_summary, counter_track_events,
+                       curve_brief, export_profile_trace,
+                       format_attribution, format_latency, format_profile,
                        latency_histogram_rows, latency_summary,
                        profile_summary)
 from .progress import ProgressObserver
 from .rings import ring_records, sampled_lanes
 from .series import (fault_names, format_series, lane_series,
                      series_counter_track_events, series_summary)
+from .spans import (explain_latency, format_span, request_span,
+                    request_spans)
 from .support import extract_support, support_from_records
 from .trace import export_chrome_trace, to_chrome_events
 
@@ -101,9 +111,11 @@ __all__ = [
     "profile_summary", "format_profile", "counter_track_events",
     "export_profile_trace",
     "latency_summary", "format_latency", "latency_histogram_rows",
+    "attribution_summary", "format_attribution",
     "series_summary", "format_series", "lane_series",
     "series_counter_track_events", "fault_names",
     "render_html", "sparkline_svg", "curve_brief",
     "CheckpointLog", "replay_window", "full_chain_replay",
     "divergence_report", "ReplayDivergence",
+    "request_span", "request_spans", "explain_latency", "format_span",
 ]

@@ -253,7 +253,7 @@ def attribution_bars_html(title: str, counts: dict,
     keys = [k for k in (order or sorted(counts)) if k in counts]
     keys += [k for k in sorted(counts) if k not in keys]
     total = sum(counts.values()) or 1
-    peak = max(counts.values()) or 1
+    peak = max(counts.values(), default=0) or 1
     rows = []
     for k in keys:
         v = int(counts[k])
@@ -372,6 +372,7 @@ def render_html(cur: dict, diff: dict | None = None,
     `service.report --html out.html` does)."""
     st = cur.get("store", {})
     curves = cur.get("curves", {})
+    slo = cur.get("slo") or {}
     d_new = len((diff or {}).get("buckets", {}).get("new", ()))
     d_reg = len((diff or {}).get("buckets", {}).get("regressed", ()))
     d_cov = (diff or {}).get("coverage", {}).get("added", 0)
@@ -389,6 +390,11 @@ def render_html(cur: dict, diff: dict | None = None,
               curve=curves.get("rate")),
         _tile("e2e p99", (f"{_fmt(cur['p99']['last'])}us"
                           if cur.get("p99") else "—"),
+              # the SLO verdict beside the quantile (r23): what target
+              # the campaign ran against and how many requests blew it
+              delta=(f"SLO {_fmt(slo['target'])}us — "
+                     f"{_fmt(slo.get('miss', 0))} miss"
+                     if slo.get("target") else None),
               curve=curves.get("p99"), unit="us"),
         _tile("Rounds", _fmt(st.get("max_round", 0))),
     ]

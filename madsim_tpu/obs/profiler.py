@@ -35,8 +35,8 @@ import json
 
 import numpy as np
 
-from ..parallel.stats import (latency_bucket_edges, latency_counters,
-                              profile_counters)
+from ..parallel.stats import (attribution_counters, latency_bucket_edges,
+                              latency_counters, profile_counters)
 from .rings import ring_records
 from .trace import _doc, to_chrome_events
 
@@ -176,6 +176,74 @@ def format_latency(summary: dict, node_names=None) -> str:
             f"{name[n]:<12} {summary['completions_by_node'][n]:>12} "
             f"{summary['e2e_p99_by_node'][n]:>9} "
             f"{summary['slo_miss_by_node'][n]:>9}")
+    return "\n".join(lines)
+
+
+def attribution_summary(state) -> dict | None:
+    """The tail-attribution report for a batched state (r23, DESIGN
+    §24): where SLO-missing requests spent their time, off the
+    on-device `parallel.stats.attribution_digest` reduction (O(N)
+    transfer). Per COMPLETION node: tail count and that cohort's
+    accumulated queue-wait / transit / hop totals; plus the
+    bottleneck-node histogram (which node owned each tail's dominant
+    segment — attribution proper, usually a different node than where
+    the request completed). None when the plane is compiled out
+    (cfg.span_attr False) or the state is unbatched."""
+    from ..core.state import SA_COUNT, SA_HOPS, SA_NET, SA_QWAIT
+    c = attribution_counters(state)
+    if c is None:
+        return None
+    t = np.asarray(c["tail"], np.int64)                 # [N, SA]
+    bn = c["bottleneck"]
+    tails = int(t[:, SA_COUNT].sum())
+    qwait = int(t[:, SA_QWAIT].sum())
+    net = int(t[:, SA_NET].sum())
+    return dict(
+        lanes=c["lanes"], slo_target=c["slo_target"], tails=tails,
+        qwait_us=qwait, net_us=net,
+        wait_share=(round(qwait / (qwait + net), 4)
+                    if qwait + net else None),
+        hops_mean=(round(int(t[:, SA_HOPS].sum()) / tails, 2)
+                   if tails else None),
+        tails_by_node=t[:, SA_COUNT].tolist(),
+        qwait_by_node=t[:, SA_QWAIT].tolist(),
+        net_by_node=t[:, SA_NET].tolist(),
+        bottleneck_by_node=bn,
+        bottleneck_node=(int(np.argmax(bn)) if tails else None),
+    )
+
+
+def format_attribution(summary: dict, node_names=None) -> str:
+    """Render an `attribution_summary` dict as a fixed-width table —
+    the operator-facing answer to "who owns the tail". The bottleneck
+    column counts DOMINANT segments owned; the starred row is the
+    cluster's bottleneck node."""
+    if summary is None:
+        return ("attribution plane compiled out "
+                "(SimConfig.span_attr=False)")
+    N = len(summary["tails_by_node"])
+    name = (node_names if node_names is not None
+            else [f"node{n}" for n in range(N)])
+    ws = summary["wait_share"]
+    lines = [
+        f"recorded lanes: {summary['lanes']}  "
+        f"slo_target: {summary['slo_target']}us  "
+        f"tail requests: {summary['tails']}",
+        f"tail time split: wait {summary['qwait_us']}us / "
+        f"transit {summary['net_us']}us"
+        + (f" (wait share {ws:.1%})" if ws is not None else "")
+        + (f"  mean hops: {summary['hops_mean']}"
+           if summary["hops_mean"] is not None else ""),
+        f"{'node':<12} {'tails':>8} {'wait_us':>12} {'transit_us':>12} "
+        f"{'bottleneck':>11}",
+    ]
+    for n in range(N):
+        star = " *" if summary["bottleneck_node"] == n else ""
+        lines.append(
+            f"{name[n]:<12} {summary['tails_by_node'][n]:>8} "
+            f"{summary['qwait_by_node'][n]:>12} "
+            f"{summary['net_by_node'][n]:>12} "
+            f"{summary['bottleneck_by_node'][n]:>11}{star}")
     return "\n".join(lines)
 
 

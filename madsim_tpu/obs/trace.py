@@ -19,7 +19,20 @@ straight off the timeline. Ring sources with lineage columns (r10) also
 render message causality: every resolvable happens-before edge becomes a
 Perfetto flow arrow (`ph:"s"` at the enqueuing dispatch, `ph:"f"` at the
 child), and instant args carry step/lamport/parent for trace-side joins
-against `explain_crash` chains and divergence reports.
+against `explain_crash` chains and divergence reports. Attribution-plane
+rings (r23, cfg.span_attr — marked by the `qw` queue-wait column)
+additionally render every recorded completion as an async REQUEST
+DURATION span: a `ph:"b"`/`ph:"e"` pair from the request's root dispatch
+to its completion (id = the completion's dispatch index, args carry
+lat_us), so tail requests read as long bars above the instant tracks and
+join against `explain_latency` critical paths.
+
+Export contract: `export_chrome_trace` returns the INSTANT count only.
+Flow arrows, counter samples, and request spans ride in the document but
+are never counted — they annotate dispatches. A document written from a
+build with a plane disabled is byte-identical to one written before that
+plane existed (golden-JSON tested against the frozen r22 capture,
+tests/test_spans.py).
 """
 
 from __future__ import annotations
@@ -120,6 +133,25 @@ def to_chrome_events(source, b: int = 0) -> list[dict]:
                             tid=int(cols["node"][j])))
             out.append(dict(flow, ph="f", bp="e", ts=int(cols["now"][i]),
                             tid=int(cols["node"][i])))
+    lats = cols.get("lat")
+    if steps is not None and lats is not None and "qw" in cols:
+        # request duration spans (r23): one async "b"/"e" pair per
+        # recorded completion, spanning its root dispatch → completion
+        # in virtual time (ts = now − recorded e2e), id = the
+        # completion's dispatch index — joinable against
+        # `explain_latency` output. Gated on the `qw` column, the
+        # attribution plane's ring marker (cfg.span_attr): a span-off
+        # document is byte-identical to what r22 wrote.
+        for i in range(n):
+            lat = int(lats[i])
+            if lat < 0:
+                continue
+            span = dict(name=f"request:tag{int(cols['tag'][i])}",
+                        cat="request", id=int(steps[i]), pid=0)
+            out.append(dict(span, ph="b", ts=int(cols["now"][i]) - lat,
+                            args=dict(step=int(steps[i]), lat_us=lat,
+                                      node=int(cols["node"][i]))))
+            out.append(dict(span, ph="e", ts=int(cols["now"][i])))
     return out
 
 
@@ -129,8 +161,10 @@ def export_chrome_trace(path: str, events=None, b: int = 0,
     of INSTANT events written — which equals the lane's `fired=True`
     record count (collect_events source) or its surviving ring length
     (state source). Causal flow arrows (`ph:"s"/"f"` pairs, emitted for
-    ring sources with lineage columns) ride in the document but are not
-    counted — they annotate dispatches, they aren't dispatches.
+    ring sources with lineage columns) and request duration spans
+    (`ph:"b"/"e"` pairs, emitted for attribution-plane rings, r23) ride
+    in the document but are not counted — they annotate dispatches,
+    they aren't dispatches.
 
     Pass exactly one source: `events` (+ `b`) from a
     `collect_events=True` run, or `state` (+ `lane`) to read the

@@ -492,6 +492,11 @@ def latency_brief(state) -> dict | None:
                 e2e_p50=c["e2e_p50"], e2e_p99=c["e2e_p99"],
                 e2e_p999=c["e2e_p999"], sojourn_p99=c["sojourn_p99"],
                 slo_miss=c["slo_miss"],
+                # the dynamic per-lane target, folded like the
+                # attribution digest folds it (max = the report knob) —
+                # so dashboards can show WHAT the misses missed (r23)
+                slo_target=int(np.asarray(
+                    getattr(state, "slo_target", 0)).max()),
                 completions=int(c["e2e_hist"].sum()))
 
 
@@ -656,6 +661,97 @@ def series_brief(state) -> dict | None:
     return out
 
 
+@jax.jit
+def _attribution_digest(sa_tail, sa_bottleneck, sp_on, slo_target):
+    """Device-side reduction of the critical-path attribution plane
+    (cfg.span_attr, DESIGN §24): wide masked batch sums of the
+    per-completion-node [N, SA_COMPONENTS] tail counters and of the
+    dominant-hop bottleneck histogram — the shared `_masked_half_sums`
+    plumbing, same ship-summaries discipline as the profiler/latency/
+    series digests. O(N) crosses the host boundary, never the lanes."""
+    onf = sp_on
+    w = onf.astype(jnp.int32)
+    s64 = _masked_half_sums
+    return dict(
+        lanes=w.sum(),
+        # dominant dynamic SLO across the recording lanes (normally
+        # shared; retune can split the batch — max is the report knob)
+        slo_target=jnp.where(onf, slo_target, 0).max(),
+        tail=s64(sa_tail, w[:, None, None]),              # [2, N, SA]
+        bottleneck=s64(sa_bottleneck, w[:, None]),        # [2, N]
+    )
+
+
+def attribution_digest(state):
+    """Launch the device-side attribution reduction over a batched
+    state; returns DEVICE arrays (force lazily) or None when the plane
+    is compiled out (cfg.span_attr False) or the state is unbatched."""
+    sa = getattr(state, "sa_tail", None)
+    if sa is None or sa.ndim != 3 or sa.shape[1] == 0:
+        return None
+    return _attribution_digest(state.sa_tail, state.sa_bottleneck,
+                               state.sp_on, state.slo_target)
+
+
+def attribution_counters(state) -> dict | None:
+    """Materialize `attribution_digest` host-side: exact int64 tail
+    component sums per completion node ([N, SA_COMPONENTS]:
+    count/qwait/net/hops — core/state.py SA_*) and the bottleneck-node
+    histogram ([N]: dominant-segment owner of each tail request). None
+    when the plane is compiled out. Run-twice confirmed + memoized
+    (`_confirmed_digest` — the r20 persistent-cache containment)."""
+    sa = getattr(state, "sa_tail", None)
+    if sa is None or sa.ndim != 3 or sa.shape[1] == 0:
+        return None
+    d = _confirmed_digest(
+        attribution_digest, state,
+        (state.sa_tail, state.sa_bottleneck, state.sp_on,
+         state.slo_target))
+    if d is None:
+        return None
+
+    def wide(a):
+        a = a.astype(np.int64)
+        return a[0] * 65536 + a[1]
+
+    return dict(
+        lanes=int(d["lanes"]),
+        slo_target=int(d["slo_target"]),
+        tail=wide(d["tail"]),                             # int64 [N, SA]
+        bottleneck=wide(d["bottleneck"]).tolist(),
+    )
+
+
+def attribution_brief(state) -> dict | None:
+    """The small JSON-able attribution rollup `summarize()` carries:
+    how many requests blew the SLO, where their time went (queue-wait
+    vs network/disk transit, cluster-total µs and the wait share),
+    their mean hop depth, and which node owned the dominant segment
+    most often. None when the plane is compiled out."""
+    from ..core.state import SA_COUNT, SA_HOPS, SA_NET, SA_QWAIT
+    c = attribution_counters(state)
+    if c is None:
+        return None
+    t = c["tail"]
+    tails = int(t[:, SA_COUNT].sum())
+    qwait = int(t[:, SA_QWAIT].sum())
+    net = int(t[:, SA_NET].sum())
+    hops = int(t[:, SA_HOPS].sum())
+    bn = c["bottleneck"]
+    out = dict(lanes=c["lanes"], slo_target=c["slo_target"],
+               tails=tails, qwait_us=qwait, net_us=net,
+               wait_share=(round(qwait / (qwait + net), 4)
+                           if qwait + net else None),
+               hops_mean=round(hops / tails, 2) if tails else None,
+               tails_by_node=t[:, SA_COUNT].tolist(),
+               bottleneck_by_node=bn)
+    if tails:
+        out["bottleneck_node"] = int(np.argmax(bn))
+        out["bottleneck_share"] = round(max(bn) / sum(bn), 4) if sum(bn) \
+            else None
+    return out
+
+
 def schedule_representatives(state, seeds) -> dict:
     """{sched_hash: first seed that produced it} — one replayable
     representative per distinct interleaving class. After a sweep, replay
@@ -756,6 +852,11 @@ def summarize(rt, state, seeds=None) -> dict:
         # rollup — peak window, transient p99 spike, fault windows.
         # None when cfg.series_windows is 0.
         series=series_brief(state),
+        # WHY the tail was slow (r23): queue-wait vs transit split and
+        # the bottleneck-node histogram over SLO-missing requests, off
+        # the critical-path attribution plane — None when
+        # cfg.span_attr is off.
+        attribution=attribution_brief(state),
         oops=int((np.asarray(state.oops) != 0).sum()),
     )
 

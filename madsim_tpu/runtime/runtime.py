@@ -262,7 +262,7 @@ class Runtime:
 
     def init_batch(self, seeds, trace_lanes=None,
                    profile_lanes=None, latency_lanes=None,
-                   series_lanes=None) -> SimState:
+                   series_lanes=None, span_lanes=None) -> SimState:
         """Initial batched state for an array of seeds (replay-by-seed:
         the same seed always reproduces the same trajectory, the
         MADSIM_TEST_SEED contract of macros lib.rs:141-145).
@@ -295,6 +295,13 @@ class Runtime:
         `invariant=` is harness.recovery_invariant should keep every
         lane on — a masked lane's windows never fill, so its recovery
         oracle can never fire (the slo_invariant rule).
+
+        span_lanes: which lanes the critical-path attribution plane
+        attributes when cfg.span_attr (None = all; same forms; bench.py
+        --mode span_ab bounds the masked cost). Like ev_root_t, the
+        carried ev_span column is maintained on every lane regardless —
+        only the sa_* counter folds are gated — so flipping a lane on
+        mid-campaign needs no warm-up.
         """
         seeds = jnp.atleast_1d(jnp.asarray(seeds, jnp.uint32))
         keys = jax.vmap(prng.seed_key)(seeds)
@@ -341,6 +348,15 @@ class Runtime:
             mask = self._lane_mask(series_lanes, int(seeds.shape[0]),
                                    "series_lanes")
             batched = batched.replace(sr_on=jnp.asarray(mask))
+        if span_lanes is not None:
+            if not self.cfg.span_attr:
+                raise ValueError(
+                    "span_lanes given but cfg.span_attr is False — the "
+                    "attribution plane is compiled out; set "
+                    "SimConfig(span_attr=True)")
+            mask = self._lane_mask(span_lanes, int(seeds.shape[0]),
+                                   "span_lanes")
+            batched = batched.replace(sp_on=jnp.asarray(mask))
         return batched
 
     def init_single(self, seed: int) -> SimState:
@@ -783,6 +799,14 @@ class Runtime:
                 lineage["ev_root_t"] = state.ev_root_t.at[slot].set(
                     jnp.where(w, jnp.asarray(-1, jnp.int32),
                               state.ev_root_t[slot]))
+            if cfg.span_attr:
+                # and for the span plane: an injected op starts a fresh
+                # chain — nothing accumulated, no dominant segment, no
+                # emitter stamp
+                lineage["ev_span"] = state.ev_span.at[slot].set(
+                    jnp.where(w,
+                              jnp.asarray([0, 0, 0, -1, 0, -1], jnp.int32),
+                              state.ev_span[slot]))
             return state.replace(
                 **lineage,
                 t_deadline=state.t_deadline.at[slot].set(

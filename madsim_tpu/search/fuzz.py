@@ -64,7 +64,8 @@ def _lat_fields(lat_brief: dict) -> dict:
     apply_repro_knobs precedent). `search.shard` imports it too."""
     return dict(lat_p50=lat_brief["e2e_p50"],
                 lat_p99=lat_brief["e2e_p99"],
-                slo_miss=lat_brief["slo_miss"])
+                slo_miss=lat_brief["slo_miss"],
+                slo_target=lat_brief.get("slo_target", 0))
 
 
 def _env_verify_resume() -> bool:

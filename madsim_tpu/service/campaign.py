@@ -222,6 +222,9 @@ def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
                        end-to-end p99 over wall time, from rows whose
                        worker ran with the SLO latency plane compiled
                        in (cfg.latency_hist > 0, r16); empty otherwise
+      slo              {target, miss} — the advertised SLO target (µs)
+                       and total misses over every deduped round row
+                       (r23); None when no row carried latency fields
       workers_health   {label: {last_seen, age_s, rounds_done, sync_gap_s,
                        stale}} — `stale` means the CAMPAIGN has newer
                        activity than the worker: no row of this worker
@@ -297,9 +300,17 @@ def campaign_timeline(store: CorpusStore, stale_after: float = 3.0,
             rate_curve.append([t_rel, round(cov / wall, 2)])
         if r.get("lat_p99") is not None:
             p99_curve.append([t_rel, int(r["lat_p99"])])
+    # SLO rollup (r23): total misses over every deduped round row plus
+    # the last advertised target — the tile next to the p99 curve. None
+    # when no worker ran the latency plane (section doesn't render).
+    slo_rows = [r for r in rows if r.get("slo_miss") is not None]
+    slo = (dict(target=max((int(r.get("slo_target", 0))
+                            for r in slo_rows), default=0),
+                miss=sum(int(r["slo_miss"]) for r in slo_rows))
+           if slo_rows else None)
     return dict(timeline=rows, coverage_curve=coverage_curve,
                 rate_curve=rate_curve, p99_curve=p99_curve,
-                workers_health=health)
+                slo=slo, workers_health=health)
 
 
 def campaign_report(corpus_dir: str, uptime_s: float = 0.0,
@@ -324,6 +335,7 @@ def campaign_report(corpus_dir: str, uptime_s: float = 0.0,
         coverage_curve=tl["coverage_curve"],
         rate_curve=tl["rate_curve"],
         p99_curve=tl["p99_curve"],
+        slo=tl["slo"],
         workers_health=tl["workers_health"],
         stale_workers=sorted(w for w, h in tl["workers_health"].items()
                              if h["stale"]),

@@ -442,6 +442,10 @@ def triage_snapshot(store_or_dir, quiet_rounds: int = 2) -> tuple[int, dict]:
                     rate=_downsample(tl["rate_curve"]),
                     p99=_downsample(tl["p99_curve"])),
         p99=curve_brief(tl["p99_curve"]),
+        # the SLO context for the p99 tile (r23): target + total misses
+        # over the deduped timeline; None when no worker ran the
+        # latency plane — the tile then shows the curve alone
+        slo=tl.get("slo"),
         rate=curve_brief(tl["rate_curve"]),
         workers_health=health,
         audit={k: dict(v) for k, v in sorted(audit.items())

@@ -13,6 +13,11 @@ it gates against:
     # r21 contract (captured at r20 HEAD, before the windowed-telemetry plane)
     JAX_PLATFORMS=cpu python scripts/capture_golden.py _series_golden
 
+    # r23 contract (captured at r22 HEAD, before the attribution plane;
+    # tests/data/golden_r22_trace.json — the span-off Chrome-trace
+    # byte-identity golden — was captured at the same point)
+    JAX_PLATFORMS=cpu python scripts/capture_golden.py _span_golden
+
 Re-running a capture after the gated engine change landed would
 overwrite the evidence with whatever the current tree produces — the
 test would then prove nothing.

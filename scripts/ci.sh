@@ -90,6 +90,16 @@ case "$tier" in
     # counter tracks, and a burst-guided fuzz campaign must open a
     # CRASH_RECOVERY bucket whose (seed, knobs) handle replays red
     python bench.py --series-smoke
+    # attribution-plane smoke: the device's per-(lane, node) tail
+    # counters must equal a host parent-walk of the flight-recorder
+    # ring on every component (count/queue-wait/net/hops), the plane
+    # on/compiled-out must be bit-identical, a pause/resume workload
+    # must telescope host request spans exactly (wait + transit == e2e)
+    # with the dominant-node fold matching the device bottleneck
+    # histogram, explain_latency must be deterministic on re-run, and
+    # the Perfetto export must carry request duration spans iff the
+    # plane is on
+    python bench.py --span-smoke
     # gray-failure smoke: a one-way cut must be observed asymmetrically
     # by gossip, skewed lease expiry on the Percolator-lite flagship
     # must crash the snapshot oracle and reproduce on seed replay, and

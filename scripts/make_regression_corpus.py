@@ -8,11 +8,12 @@ buckets + worker state) plus a REGRESSION.json sidecar naming the
 runtime factory and replay budget. Re-run this ONLY when the store
 signature legitimately moves (a new knob dimension, a structural change
 to a flagship) — the whole point of the gate is that buckets keep
-reproducing across unrelated changes. (Last re-frozen at r21: the
-simconfig-v7 bump — the windowed-telemetry plane's structural window
-count — rejects pre-r21 corpus dirs with StoreMismatch, so both
-campaigns were regenerated; the trajectories themselves are
-bit-identical to the r19 freeze, per the golden-equivalence gates.)
+reproducing across unrelated changes. (Last re-frozen at r23: the
+simconfig-v8 bump — the attribution plane's structural span_attr gate —
+rejects pre-r23 corpus dirs with StoreMismatch, so both campaigns were
+regenerated; the trajectories themselves are bit-identical to the r21
+freeze, per the golden-equivalence gates. The r21 freeze did the same
+for the v7 windowed-telemetry bump.)
 
     JAX_PLATFORMS=cpu python scripts/make_regression_corpus.py [name ...]
 """

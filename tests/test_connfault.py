@@ -62,7 +62,9 @@ class TestEquivalenceR18:
                 == {".dup_rate",
                     ".sr_on", ".window_len", ".sr_dispatch", ".sr_busy",
                     ".sr_qhw", ".sr_drop", ".sr_dup", ".sr_complete",
-                    ".sr_slo_miss", ".sr_lat", ".sr_fault"}, \
+                    ".sr_slo_miss", ".sr_lat", ".sr_fault",
+                    ".sp_on", ".ev_span", ".sa_tail",
+                    ".sa_bottleneck", ".tr_qw"}, \
                 (runner, set(got[runner]) - set(gold[runner]))
 
 
@@ -537,6 +539,7 @@ class TestCheckpointMigration:
 
     def test_signature_is_current(self):
         # v6 (r19) was bumped to v7 by the r21 windowed-telemetry
-        # plane — test_series.py owns the authoritative assertion
+        # plane and to v8 by the r23 attribution plane —
+        # test_spans.py owns the authoritative assertion
         cfg = SimConfig(n_nodes=2)
-        assert cfg.structural_signature()[0] == "simconfig-v7"
+        assert cfg.structural_signature()[0] == "simconfig-v8"

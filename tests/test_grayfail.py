@@ -47,10 +47,12 @@ class TestEquivalenceR16:
         # OTHER leaf must still match r16 bit for bit), r19's
         # dup_rate (connection-fault plane, simconfig-v6 — its own
         # golden gate lives in tests/test_connfault.py vs r18 truth),
-        # and r21's windowed-telemetry plane (sr_*/window_len,
+        # r21's windowed-telemetry plane (sr_*/window_len,
         # simconfig-v7 — zero-size columns here since series_windows=0;
         # its own golden gate lives in tests/test_series.py vs r20
-        # truth).
+        # truth), and r23's attribution plane (sp_on/ev_span/sa_*/tr_qw,
+        # simconfig-v8 — zero-size here since span_attr is off; its own
+        # golden gate lives in tests/test_spans.py vs r22 truth).
         gold = golden.load_golden()[workload]
         got = golden.run_workload(workload)
         for runner in ("run", "run_fused"):
@@ -65,7 +67,9 @@ class TestEquivalenceR16:
                            ".sr_on", ".window_len", ".sr_dispatch",
                            ".sr_busy", ".sr_qhw", ".sr_drop", ".sr_dup",
                            ".sr_complete", ".sr_slo_miss", ".sr_lat",
-                           ".sr_fault"}, new
+                           ".sr_fault",
+                           ".sp_on", ".ev_span", ".sa_tail",
+                           ".sa_bottleneck", ".tr_qw"}, new
 
 
 # ---------------------------------------------------------------------------
@@ -489,7 +493,8 @@ class TestCheckpointMigration:
 
     def test_signature_is_current(self):
         # r17 introduced v5; the r19 connection-fault plane bumped it to
-        # v6, and the r21 windowed-telemetry plane to v7 —
-        # test_series.py owns the authoritative version assertion
+        # v6, the r21 windowed-telemetry plane to v7, and the r23
+        # attribution plane to v8 — test_spans.py owns the
+        # authoritative version assertion
         cfg = SimConfig(n_nodes=2)
-        assert cfg.structural_signature()[0] == "simconfig-v7"
+        assert cfg.structural_signature()[0] == "simconfig-v8"

@@ -15,7 +15,8 @@ without recompiling or perturbing trajectories; (5) the batch digest
 period after the LAST disruptive fault, heals don't restart the clock,
 and it fires deterministically with CRASH_RECOVERY; (7) the fuzzer's
 burst_bonus scales admission energy by the deepest TRANSIENT spike;
-(8) pre-r21 checkpoints are rejected loudly (simconfig-v7).
+(8) pre-r21 checkpoints are rejected loudly (v7 then; simconfig-v8
+since the r23 attribution plane).
 """
 
 import jax
@@ -94,7 +95,12 @@ class TestEquivalenceR20:
                     if gold[runner][k] != got[runner][k]]
             assert not diff, (runner, diff)
             new = set(got[runner]) - set(gold[runner])
-            assert new == {"." + n for n in SR_LEAVES}, new
+            # the r23 attribution plane's leaves ride along (zero-size
+            # here — the frozen workloads never set span_attr; their
+            # own golden gate lives in tests/test_spans.py)
+            span = {".sp_on", ".ev_span", ".sa_tail", ".sa_bottleneck",
+                    ".tr_qw"}
+            assert new == {"." + n for n in SR_LEAVES} | span, new
 
 
 # ---------------------------------------------------------------------------
@@ -155,9 +161,11 @@ class TestSeriesPlane:
         with pytest.raises(ValueError, match="series"):
             rt.init_batch(np.arange(4), series_lanes=[0])
 
-    def test_signature_is_v7_and_window_len_is_not_structural(self):
+    def test_signature_and_window_len_is_not_structural(self):
+        # v7 here at r21; the r23 attribution plane bumped it to v8 —
+        # test_spans.py owns the authoritative version assertion
         cfg = SimConfig(n_nodes=2)
-        assert cfg.structural_signature()[0] == "simconfig-v7"
+        assert cfg.structural_signature()[0] == "simconfig-v8"
         # the window COUNT shapes the program; the window LENGTH is an
         # operand (the r8 structural/dynamic discipline)
         a = SimConfig(n_nodes=2, series_windows=8)
